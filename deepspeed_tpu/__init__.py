@@ -1,0 +1,128 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability set of the
+reference framework (DeepSpeed, mounted at /root/reference): engine API
+(``initialize`` mirrors ``deepspeed/__init__.py:69``), ZeRO-style sharded
+training, tensor/pipeline/expert/sequence parallelism as mesh axes, a
+collective façade, Pallas kernels, checkpointing, and an inference engine.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .config.config import Config, parse_config
+from .parallel.topology import Grid, MeshSpec, initialize_mesh
+from .runtime.dataloader import DeepSpeedTpuDataLoader, RepeatingLoader
+from .runtime.engine import DeepSpeedTpuEngine, TrainState
+from .utils.logging import log_dist, logger
+
+
+def _mesh_axes_from_config(cfg: Config, world: int, zero_stage: int):
+    """Resolve mesh axis sizes: explicit sizes win; leftover devices go to
+    ``fsdp`` when ZeRO>=1 (partitioning wants the fsdp axis) else ``data``."""
+    m = cfg.mesh
+    fixed = {}
+    for ax in ("model", "seq", "expert", "stage"):
+        v = getattr(m, ax)
+        if v and v > 1:
+            fixed[ax] = v
+    if m.data:
+        fixed["data"] = m.data
+    if m.fsdp:
+        fixed["fsdp"] = m.fsdp
+    import math
+
+    used = math.prod(fixed.values()) if fixed else 1
+    if "data" not in fixed and "fsdp" not in fixed:
+        leftover = world // used
+        if zero_stage >= 1:
+            fixed["fsdp"] = leftover
+            fixed["data"] = 1
+        else:
+            fixed["data"] = leftover
+    elif "data" not in fixed:
+        fixed["data"] = world // used
+    elif "fsdp" not in fixed:
+        fixed["fsdp"] = world // used
+    return fixed
+
+
+def initialize(
+    loss_fn: Optional[Callable] = None,
+    params: Any = None,
+    config: Any = None,
+    model: Any = None,
+    training_data: Any = None,
+    lr_scheduler: Any = None,
+    mesh: Optional[Grid] = None,
+    tp_rules=None,
+    eval_fn: Optional[Callable] = None,
+    collate_fn: Optional[Callable] = None,
+    dist_init_required: Optional[bool] = None,
+    args: Any = None,
+):
+    """Build the engine — the ``deepspeed.initialize()`` equivalent
+    (reference deepspeed/__init__.py:69).
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)`` like the
+    reference.  ``optimizer`` is the engine itself (the optax transform is
+    internal to the jitted step); ``lr_scheduler`` is the engine's scheduler
+    shim.
+
+    Two ways to describe the model:
+    - ``loss_fn(params, batch, rng) -> scalar`` + initialized ``params``
+    - ``model`` = a flax module adapter from ``deepspeed_tpu.models`` that
+      exposes ``.loss_fn`` / ``.init_params(rng)`` / ``.tp_rules``
+    """
+    cfg = parse_config(config)
+    if dist_init_required:
+        comm.comm.init_distributed()
+
+    if model is not None and loss_fn is None:
+        loss_fn = model.loss_fn
+        if params is None:
+            import jax
+
+            params = model.init_params(jax.random.PRNGKey(cfg.seed))
+        if tp_rules is None:
+            tp_rules = getattr(model, "tp_rules", None)
+
+    if loss_fn is None or params is None:
+        raise ValueError("initialize() needs (loss_fn, params) or model=")
+
+    import jax
+
+    if mesh is None:
+        axes = _mesh_axes_from_config(cfg, jax.device_count(), cfg.zero_optimization.stage)
+        mesh = initialize_mesh(**axes)
+    cfg.finalize(mesh.dp_world_size)
+    comm.comm.configure(cfg.comms_logger)
+
+    engine = DeepSpeedTpuEngine(
+        loss_fn=loss_fn,
+        params=params,
+        config=cfg,
+        grid=mesh,
+        tp_rules=tp_rules,
+        eval_fn=eval_fn,
+    )
+    from .monitor.monitor import MonitorMaster
+
+    engine.monitor = MonitorMaster(cfg)
+
+    dataloader = None
+    if training_data is not None:
+        dataloader = DeepSpeedTpuDataLoader(
+            training_data,
+            micro_batch_size=cfg.train_micro_batch_size_per_gpu,
+            dp_world_size=mesh.dp_world_size,
+            gradient_accumulation_steps=cfg.gradient_accumulation_steps,
+            collate_fn=collate_fn,
+            seed=cfg.seed,
+        )
+    if lr_scheduler is not None:
+        log_dist("external lr_scheduler object ignored; use config['scheduler']")
+    return engine, engine, dataloader, engine.lr_scheduler

@@ -1,0 +1,1 @@
+from .tpu_accelerator import get_accelerator, TpuAccelerator  # noqa: F401

@@ -1,0 +1,134 @@
+"""Accelerator abstraction: device discovery, memory stats, platform info.
+
+TPU-native counterpart of the reference's hardware-abstraction layer
+(``accelerator/abstract_accelerator.py:10 DeepSpeedAccelerator`` ABC +
+``real_accelerator.py:51 get_accelerator()`` auto-detection with the
+``DS_ACCELERATOR`` env override).  The torch-centric surface (streams,
+events, RNG state, graph capture) has no TPU analogue — XLA owns scheduling —
+so the API here is the subset that still carries meaning: device queries,
+memory stats, dtype support, platform naming, and the communication backend
+name (which on TPU is "xla:ici").  ``DSTPU_ACCELERATOR=cpu`` forces the CPU
+backend (mirror of ``DS_ACCELERATOR``), which is how the test harness runs an
+8-device virtual mesh.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, Optional
+
+
+class TpuAccelerator:
+    """Device/platform queries backed by jax (singleton via get_accelerator)."""
+
+    def __init__(self, platform: Optional[str] = None):
+        self._platform = platform
+
+    # --- naming (reference: accelerator/cuda_accelerator.py) ---
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self.platform()
+        return f"{self.platform()}:{device_index}"
+
+    @functools.lru_cache(None)
+    def platform(self) -> str:
+        import jax
+
+        return jax.default_backend()
+
+    def is_available(self) -> bool:
+        import jax
+
+        try:
+            return len(jax.devices()) > 0
+        except RuntimeError:
+            return False
+
+    def device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def local_device_count(self) -> int:
+        import jax
+
+        return jax.local_device_count()
+
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def current_device(self):
+        import jax
+
+        return jax.local_devices()[0]
+
+    def communication_backend_name(self) -> str:
+        """reference: cuda_accelerator.py:28 -> 'nccl'; here XLA over ICI."""
+        return "xla:ici"
+
+    # --- memory (reference: memory_allocated/memory_stats API family) ---
+    def memory_stats(self, device=None) -> Dict[str, int]:
+        dev = device or self.current_device()
+        try:
+            stats = dev.memory_stats()
+            return dict(stats) if stats else {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device=None) -> int:
+        return self.memory_stats(device).get("bytes_in_use", 0)
+
+    def total_memory(self, device=None) -> int:
+        return self.memory_stats(device).get("bytes_limit", 0)
+
+    def available_memory(self, device=None) -> int:
+        s = self.memory_stats(device)
+        return max(s.get("bytes_limit", 0) - s.get("bytes_in_use", 0), 0)
+
+    # --- dtype support (reference: is_bf16_supported etc.) ---
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True  # supported as a storage/compute dtype; bf16 preferred
+
+    def supported_dtypes(self) -> List[str]:
+        return ["float32", "bfloat16", "float16", "int8", "fp8_e4m3", "fp8_e5m2"]
+
+    # --- misc parity shims ---
+    def synchronize(self, obj=None):
+        import jax
+
+        if obj is not None:
+            jax.block_until_ready(obj)
+        else:
+            jax.effects_barrier()
+
+    def manual_seed(self, seed: int):
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    def device_kind(self) -> str:
+        return getattr(self.current_device(), "device_kind", self.platform())
+
+    def on_tpu(self) -> bool:
+        return self.platform() == "tpu"
+
+
+_accelerator: Optional[TpuAccelerator] = None
+
+
+def get_accelerator() -> TpuAccelerator:
+    """reference: real_accelerator.py:51 get_accelerator()."""
+    global _accelerator
+    if _accelerator is None:
+        override = os.environ.get("DSTPU_ACCELERATOR")
+        if override:
+            import jax
+
+            jax.config.update("jax_platforms", override)
+        _accelerator = TpuAccelerator()
+    return _accelerator

@@ -1,0 +1,115 @@
+"""Checkpoint save/load: topology-free by construction.
+
+TPU-native counterpart of the reference's checkpoint path
+(``engine.save_checkpoint`` runtime/engine.py:3218, ``load_checkpoint``
+:2872, ``latest`` tag file :3430, pluggable ``CheckpointEngine``
+runtime/checkpoint_engine/checkpoint_engine.py:10) **and** of universal
+checkpointing (``checkpoint/ds_to_universal.py``): because arrays are saved
+as *logical* (unsharded) tensors via orbax/TensorStore, any mesh shape can
+restore any checkpoint — the reference's offline shard-merging converter
+collapses into a no-op.  ``zero_to_fp32``-style export is just "read the
+checkpoint": masters are already fp32 logical arrays.
+
+Layout (mirrors the reference's tag-directory scheme):
+
+    <dir>/latest                      # text file holding the newest tag
+    <dir>/<tag>/state/                # orbax pytree (TrainState)
+    <dir>/<tag>/meta.json             # steps, config echo, client_state
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from ..utils.logging import log_dist
+
+LATEST_FILE = "latest"
+
+
+def _tag(engine, tag: Optional[str]) -> str:
+    return tag if tag is not None else f"global_step{engine.global_steps}"
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state=None):
+    import orbax.checkpoint as ocp
+
+    tag = _tag(engine, tag)
+    path = os.path.abspath(os.path.join(save_dir, tag))
+    os.makedirs(path, exist_ok=True)
+    ckptr = ocp.PyTreeCheckpointer()
+    state = jax.tree_util.tree_map(lambda x: x, engine.state)  # shallow copy
+    ckptr.save(os.path.join(path, "state"), state, force=True)
+    meta = {
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "lr_scheduler": engine.lr_scheduler.state_dict(),
+        "client_state": client_state or {},
+        "zero_stage": engine.config.zero_optimization.stage,
+        "dp_world_size": engine.grid.dp_world_size,
+    }
+    with open(os.path.join(path, "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+    if jax.process_index() == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
+            fh.write(tag)
+    log_dist(f"saved checkpoint {path}")
+    return path
+
+
+def get_latest_tag(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p) as fh:
+        return fh.read().strip()
+
+
+def load_checkpoint(
+    engine,
+    load_dir: str,
+    tag: Optional[str] = None,
+    load_optimizer_states: bool = True,
+    load_lr_scheduler_states: bool = True,
+) -> Tuple[Optional[str], Dict[str, Any]]:
+    import orbax.checkpoint as ocp
+
+    tag = tag or get_latest_tag(load_dir)
+    if tag is None:
+        log_dist(f"no checkpoint found under {load_dir}")
+        return None, {}
+    path = os.path.join(os.path.abspath(load_dir), tag)
+    ckptr = ocp.PyTreeCheckpointer()
+    # restore with the engine's own shardings: this is what makes checkpoints
+    # topology-free — a run on a different mesh supplies different shardings
+    # for the same logical arrays (reference needed ds_to_universal for this)
+    restore_args = jax.tree_util.tree_map(
+        lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding, dtype=x.dtype),
+        engine.state,
+    )
+    state = ckptr.restore(
+        os.path.join(path, "state"),
+        item=engine.state,
+        restore_args=restore_args,
+    )
+    if not load_optimizer_states:
+        state = state._replace(opt_state=engine.state.opt_state)
+    engine.state = state
+    with open(os.path.join(path, "meta.json")) as fh:
+        meta = json.load(fh)
+    engine.global_steps = int(meta["global_steps"])
+    engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    if load_lr_scheduler_states and "lr_scheduler" in meta:
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    log_dist(f"loaded checkpoint {path}")
+    return path, meta.get("client_state", {})
+
+
+def export_fp32_state_dict(engine):
+    """``zero_to_fp32`` equivalent (reference utils/zero_to_fp32.py:533):
+    gather the fp32 masters to host as one logical state dict."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_get(x), engine.state.params
+    )

@@ -1,0 +1,6 @@
+from . import comm  # noqa: F401
+from .comm import (  # noqa: F401
+    all_reduce, all_gather, reduce_scatter, all_to_all, ppermute, broadcast,
+    barrier, axis_rank, init_distributed, get_world_size, get_rank,
+    get_local_rank, log_summary, configure,
+)

@@ -1,0 +1,383 @@
+"""Config system: one JSON/dict tree -> validated dataclasses.
+
+TPU-native counterpart of the reference's ``runtime/config.py``
+(``DeepSpeedConfig``) + ``runtime/config_utils.py:17 DeepSpeedConfigModel``.
+Keeps the same user-facing JSON keys where they make sense
+(``train_batch_size``, ``train_micro_batch_size_per_gpu``,
+``gradient_accumulation_steps``, ``zero_optimization.stage`` ...) so a
+DeepSpeed user can bring their config file, but validation is plain
+dataclasses (no pydantic dependency) and the batch invariant is triangulated
+against the mesh's dp world size exactly as the reference does:
+
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps
+                        * dp_world_size
+(reference: runtime/config.py _configure_train_batch_size)
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+AUTO = "auto"
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _coerce(cls, value):
+    """Build a dataclass from a dict, recursing into nested dataclass fields
+    and rejecting unknown keys (the reference's pydantic models also forbid
+    extras for most sub-configs)."""
+    if value is None:
+        return cls()
+    if dataclasses.is_dataclass(value):
+        return value
+    if not isinstance(value, dict):
+        raise ConfigError(f"expected dict for {cls.__name__}, got {type(value)}")
+    names = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in value.items():
+        if k not in names:
+            raise ConfigError(f"unknown config key '{k}' for {cls.__name__}")
+        f = names[k]
+        target = None
+        if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+            probe = f.default_factory()  # type: ignore[misc]
+            if dataclasses.is_dataclass(probe):
+                target = type(probe)
+        if target is not None and isinstance(v, dict):
+            v = _coerce(target, v)
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+@dataclass
+class ZeroConfig:
+    """reference: runtime/zero/config.py:86 DeepSpeedZeroConfig."""
+
+    stage: int = 0
+    # ZeRO-3 persistence: params smaller than this stay replicated
+    # (reference: stage3_param_persistence_threshold)
+    param_persistence_threshold: int = 10_000
+    # offload targets: None | "cpu"  (host memory space)
+    offload_optimizer: Optional[str] = None
+    offload_param: Optional[str] = None
+    # ZeRO++ style knobs
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # hpZ: secondary partition size (hierarchical gather group)
+    zero_hpz_partition_size: int = 1
+    # legacy keys accepted & ignored for compat with reference configs
+    allgather_partitions: bool = True
+    overlap_comm: bool = True
+    reduce_scatter: bool = True
+    contiguous_gradients: bool = True
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: Optional[int] = None
+    reduce_bucket_size: int = 500_000_000
+    round_robin_gradients: bool = False
+    mics_shard_size: int = -1
+
+    def __post_init__(self):
+        if not 0 <= self.stage <= 3:
+            raise ConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        if self.stage3_param_persistence_threshold is not None:
+            self.param_persistence_threshold = self.stage3_param_persistence_threshold
+        for k in ("offload_optimizer", "offload_param"):
+            v = getattr(self, k)
+            if isinstance(v, dict):  # reference nests {"device": "cpu", ...}
+                setattr(self, k, v.get("device"))
+        if self.offload_optimizer not in (None, "none", "cpu", "nvme"):
+            raise ConfigError(f"bad offload_optimizer {self.offload_optimizer}")
+        if self.offload_optimizer == "none":
+            self.offload_optimizer = None
+        if self.offload_param == "none":
+            self.offload_param = None
+
+
+@dataclass
+class PrecisionConfig:
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 -> dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    consecutive_hysteresis: bool = False
+    auto_cast: bool = False
+
+
+@dataclass
+class OptimizerConfig:
+    type: str = "adamw"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+def _strip_auto(obj):
+    """Drop ``"auto"`` values at every nesting level.  HF-integration configs
+    use nested autos (e.g. optimizer.params.lr = "auto"); integrations resolve
+    them, and standalone use falls back to our defaults — matching the
+    reference's behaviour where unresolved autos are an integration concern."""
+    if isinstance(obj, dict):
+        return {k: _strip_auto(v) for k, v in obj.items() if v != AUTO}
+    if isinstance(obj, list):
+        return [_strip_auto(v) for v in obj if v != AUTO]
+    return obj
+
+
+@dataclass
+class MonitorSubConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTpuJob"
+    # wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+@dataclass
+class CommsLoggerConfig:
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: remat policy name handed to jax.checkpoint
+    policy: str = "nothing_saveable"
+
+
+@dataclass
+class MeshConfig:
+    """Mesh axis sizes; 0/absent axes are inferred (leftover -> data)."""
+
+    data: int = 0
+    fsdp: int = 0
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    stage: int = 1
+
+
+@dataclass
+class MoEConfig:
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    aux_loss_coef: float = 0.01
+
+
+@dataclass
+class TensorParallelConfig:
+    enabled: bool = False
+    tp_size: int = 1
+
+
+@dataclass
+class CheckpointConfig:
+    # async checkpointing via a background committer thread
+    use_node_local_storage: bool = False
+    load_universal: bool = False
+    async_save: bool = False
+
+
+@dataclass
+class CompressionConfig:
+    enabled: bool = False
+    weight_quantization: Dict[str, Any] = field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DataEfficiencyConfig:
+    enabled: bool = False
+    curriculum_learning: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Config:
+    """Top-level validated config (reference: DeepSpeedConfig)."""
+
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+    steps_per_print: int = 10
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+    seed: int = 42
+
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    bf16: PrecisionConfig = field(default_factory=lambda: PrecisionConfig(enabled=True))
+    fp16: PrecisionConfig = field(default_factory=PrecisionConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    moe: MoEConfig = field(default_factory=MoEConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig
+    )
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    compression_training: CompressionConfig = field(default_factory=CompressionConfig)
+    data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
+    tensorboard: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    csv_monitor: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    wandb: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    elasticity: Dict[str, Any] = field(default_factory=dict)
+
+    # --- derived (filled by finalize) ---
+    dp_world_size: int = 1
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    def finalize(self, dp_world_size: int) -> "Config":
+        """Triangulate the batch-size triple against dp_world_size.
+
+        Any two of (train_batch_size, micro_batch, gas) determine the third;
+        one alone assumes the others; all three must satisfy the invariant.
+        Mirrors reference runtime/config.py _configure_train_batch_size.
+        """
+        self.dp_world_size = dp_world_size
+        tb, mb, gas = (
+            self.train_batch_size,
+            self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps,
+        )
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ConfigError(
+                    f"batch invariant violated: {tb} != {mb} * {gas} * {dp_world_size}"
+                )
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp_world_size) != 0:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by micro_batch*dp {mb * dp_world_size}"
+                )
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp_world_size) != 0:
+                raise ConfigError(
+                    f"train_batch_size {tb} not divisible by gas*dp {gas * dp_world_size}"
+                )
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None:
+            gas = gas if gas is not None else 1
+            tb = mb * gas * dp_world_size
+        elif gas is not None:
+            mb = 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            gas = 1
+            if tb % dp_world_size != 0:
+                raise ConfigError(f"train_batch_size {tb} not divisible by dp {dp_world_size}")
+            mb = tb // dp_world_size
+        else:
+            mb, gas = 1, 1
+            tb = dp_world_size
+        self.train_batch_size, self.train_micro_batch_size_per_gpu = tb, mb
+        self.gradient_accumulation_steps = gas
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        return self
+
+
+_REFERENCE_PASSTHROUGH_KEYS = {
+    # keys a DeepSpeed JSON may contain that we accept and ignore
+    "zero_allow_untested_optimizer",
+    "zero_force_ds_cpu_optimizer",
+    "communication_data_type",
+    "sparse_gradients",
+    "amp",
+    "autotuning",
+    "aio",
+    "curriculum_learning",
+    "pipeline",
+    "comet",
+    "hybrid_engine",
+    "compile",
+    "sparse_attention",
+    "progressive_layer_drop",
+    "eigenvalue",
+    "nebula",
+    "checkpoint_engine",
+    "weight_quantization",
+}
+
+
+def parse_config(source: Any, dp_world_size: Optional[int] = None) -> Config:
+    """Parse a dict / JSON string / path into a ``Config``.
+
+    ``dp_world_size=None`` leaves batch triangulation for the engine (which
+    knows the mesh).
+    """
+    if source is None:
+        raw: Dict[str, Any] = {}
+    elif isinstance(source, Config):
+        return source
+    elif isinstance(source, dict):
+        raw = copy.deepcopy(source)
+    elif isinstance(source, str):
+        if source.strip().startswith("{"):
+            raw = json.loads(source)
+        else:
+            with open(source) as fh:
+                raw = json.load(fh)
+    else:
+        raise ConfigError(f"cannot parse config from {type(source)}")
+
+    for k in list(raw.keys()):
+        if k in _REFERENCE_PASSTHROUGH_KEYS:
+            raw.pop(k)
+    raw = _strip_auto(raw)
+    cfg = _coerce(Config, raw)
+    if dp_world_size is not None:
+        cfg.finalize(dp_world_size)
+    return cfg

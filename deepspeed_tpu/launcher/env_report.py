@@ -1,0 +1,51 @@
+"""`dstpu_report` — environment/compat report (reference: bin/ds_report ->
+deepspeed/env_report.py)."""
+from __future__ import annotations
+
+import sys
+
+
+def collect() -> dict:
+    info: dict = {}
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        info["device_count"] = jax.device_count()
+        info["devices"] = [str(d) for d in jax.devices()]
+        info["process_count"] = jax.process_count()
+    except Exception as e:
+        info["jax_error"] = str(e)
+    for mod in ("flax", "optax", "orbax.checkpoint", "einops", "numpy"):
+        try:
+            m = __import__(mod)
+            info[mod] = getattr(m, "__version__", "present")
+        except ImportError:
+            info[mod] = "MISSING"
+    try:
+        from ..ops.pallas import is_pallas_supported
+
+        info["pallas"] = "supported" if is_pallas_supported() else "interpret-mode only"
+    except Exception:
+        info["pallas"] = "unknown"
+    import deepspeed_tpu
+
+    info["deepspeed_tpu"] = deepspeed_tpu.__version__
+    return info
+
+
+def main() -> int:
+    info = collect()
+    width = max(len(k) for k in info)
+    print("-" * 50)
+    print("deepspeed_tpu environment report")
+    print("-" * 50)
+    for k, v in info.items():
+        print(f"{k:<{width}}  {v}")
+    print("-" * 50)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""`dstpu` launcher CLI — multi-host job launch for TPU pods.
+
+TPU-native counterpart of the reference's ``deepspeed`` runner
+(``launcher/runner.py:419 main`` + per-node ``launch.py:133``).  The
+reference spawns one process per GPU over pdsh/mpi/slurm and wires
+RANK/WORLD_SIZE/MASTER_* env; on TPU the unit is one process per *host* and
+rendezvous is ``jax.distributed.initialize`` against a coordinator.  So the
+launcher's job collapses to: parse a hostfile (same format), pick a
+coordinator, ssh (or slurm) the same command to every host with
+``DSTPU_COORDINATOR / DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID`` env, and
+propagate signals.  On a single host it just execs the script.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+DEFAULT_COORD_PORT = 8476
+
+
+def fetch_hostfile(path: str) -> Dict[str, int]:
+    """Parse the reference hostfile format: ``hostname slots=N`` per line
+    (reference launcher/runner.py:213)."""
+    hosts: Dict[str, int] = {}
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hostfile {path} not found")
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            name = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if name in hosts:
+                raise ValueError(f"duplicate host {name} in hostfile")
+            hosts[name] = slots
+    if not hosts:
+        raise ValueError(f"hostfile {path} is empty")
+    return hosts
+
+
+def filter_hosts(
+    hosts: Dict[str, int], include: str = "", exclude: str = ""
+) -> Dict[str, int]:
+    """``--include/--exclude`` host filters (reference launcher/runner.py:293;
+    the @-slot syntax is GPU-indexed and does not apply — hosts only)."""
+    sel = dict(hosts)
+    if include:
+        names = [h.strip() for h in include.split(",") if h.strip()]
+        unknown = [n for n in names if n not in hosts]
+        if unknown:
+            raise ValueError(f"--include hosts not in hostfile: {unknown}")
+        sel = {n: hosts[n] for n in names}
+    if exclude:
+        for n in exclude.split(","):
+            n = n.strip()
+            if n and n in sel:
+                del sel[n]
+    if not sel:
+        raise ValueError("host filters removed every host")
+    return sel
+
+
+def build_host_commands(
+    hosts: Dict[str, int],
+    cmd: List[str],
+    coordinator: Optional[str] = None,
+    port: int = DEFAULT_COORD_PORT,
+    env_passthrough: Optional[List[str]] = None,
+) -> List[Tuple[str, List[str]]]:
+    """One (host, remote_command) per host, with rendezvous env set."""
+    host_list = list(hosts)
+    coordinator = coordinator or host_list[0]
+    out = []
+    for i, h in enumerate(host_list):
+        env = {
+            "DSTPU_COORDINATOR": f"{coordinator}:{port}",
+            "DSTPU_NUM_PROCESSES": str(len(host_list)),
+            "DSTPU_PROCESS_ID": str(i),
+        }
+        for k in env_passthrough or []:
+            if k in os.environ:
+                env[k] = os.environ[k]
+        envstr = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        remote = ["ssh", "-o", "StrictHostKeyChecking=no", h,
+                  f"cd {shlex.quote(os.getcwd())} && {envstr} {' '.join(shlex.quote(c) for c in cmd)}"]
+        out.append((h, remote))
+    return out
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu multi-host launcher"
+    )
+    p.add_argument("--hostfile", default=None, help="hostfile (hostname slots=N lines)")
+    p.add_argument("--include", default="", help="comma-separated hosts to include")
+    p.add_argument("--exclude", default="", help="comma-separated hosts to exclude")
+    p.add_argument("--coordinator", default=None, help="coordinator host (default: first)")
+    p.add_argument("--coordinator-port", type=int, default=DEFAULT_COORD_PORT)
+    p.add_argument("--env", action="append", default=[], help="env var names to forward")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    cmd = [sys.executable, args.script] + list(args.script_args)
+    if args.hostfile is None:
+        logger.info("no hostfile: launching single-process locally")
+        return subprocess.call(cmd)
+    hosts = filter_hosts(fetch_hostfile(args.hostfile), args.include, args.exclude)
+    launches = build_host_commands(
+        hosts, cmd, args.coordinator, args.coordinator_port, args.env
+    )
+    procs = []
+    for host, remote in launches:
+        logger.info(f"launching on {host}: {' '.join(remote[-1:])}")
+        procs.append(subprocess.Popen(remote))
+
+    def _kill(signum, frame):
+        for p in procs:
+            p.terminate()
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Monitoring fan-out: TensorBoard / CSV / WandB writers.
+
+TPU-native counterpart of ``deepspeed/monitor/monitor.py:30 MonitorMaster``
+and the per-backend writers (monitor/{tensorboard,csv_monitor,wandb}.py).
+Events are ``(label, value, step)`` triples, written on process 0 only —
+same contract as the reference (``engine.py:2426 _write_monitor``).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=path)
+            except Exception as e:  # tensorboard optional
+                logger.warning(f"tensorboard unavailable ({e}); disabling")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        if self.summary_writer is None:
+            return
+        for label, value, step in events:
+            self.summary_writer.add_scalar(label, value, step)
+        self.summary_writer.flush()
+
+
+class CsvMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.path = None
+        if self.enabled:
+            self.path = os.path.join(config.output_path or ".", config.job_name)
+            os.makedirs(self.path, exist_ok=True)
+
+    def write_events(self, events: List[Event]):
+        for label, value, step in events:
+            fname = os.path.join(self.path, label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", label])
+                w.writerow([step, value])
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(
+                    project=config.project, group=config.group, entity=config.team
+                )
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable ({e}); disabling")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        if self._wandb is None:
+            return
+        for label, value, step in events:
+            self._wandb.log({label: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Dispatch to every enabled writer, rank-0 only (monitor/monitor.py:30)."""
+
+    def __init__(self, config):
+        import jax
+
+        self.rank0 = jax.process_index() == 0
+        self.writers: List[Monitor] = []
+        if self.rank0:
+            for w in (
+                TensorBoardMonitor(config.tensorboard),
+                CsvMonitor(config.csv_monitor),
+                WandbMonitor(config.wandb),
+            ):
+                if w.enabled:
+                    self.writers.append(w)
+        self.enabled = bool(self.writers)
+
+    def write_events(self, events: List[Event]):
+        for w in self.writers:
+            w.write_events(events)

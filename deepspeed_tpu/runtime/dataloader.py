@@ -1,0 +1,115 @@
+"""Data loading: deterministic DP sharding + RepeatingLoader.
+
+TPU-native counterpart of ``runtime/dataloader.py`` (``DeepSpeedDataLoader``
+:41, ``RepeatingLoader`` :17) and the engine hook ``deepspeed_io``
+(engine.py:1831).  The loader yields *global* batches shaped
+``[gas, global_micro_batch, ...]`` as numpy arrays; the engine's jit scatters
+them across the mesh (each host only materializes its addressable shard via
+``jax.make_array_from_process_local_data`` on multi-host).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """reference: runtime/dataloader.py:17 — wrap an iterator to restart on
+    StopIteration (for infinite training loops)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedTpuDataLoader:
+    """Shards an indexable dataset deterministically and emits
+    ``[gas, micro, ...]`` numpy batches.
+
+    ``dataset`` must support ``__len__`` and ``__getitem__`` returning either
+    an array/tuple/dict of arrays.  ``collate_fn`` stacks samples (default:
+    np.stack per leaf).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        micro_batch_size: int,
+        dp_world_size: int = 1,
+        gradient_accumulation_steps: int = 1,
+        dp_rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable] = None,
+        global_batches: bool = True,
+    ):
+        self.dataset = dataset
+        self.micro_batch_size = micro_batch_size
+        self.gas = gradient_accumulation_steps
+        self.dp_world_size = dp_world_size
+        self.dp_rank = dp_rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        # single-process: emit full global batches; multi-host: per-rank shards
+        self.global_batches = global_batches
+        per_step = micro_batch_size * dp_world_size * self.gas
+        # static shapes are a TPU requirement: partial trailing batches are
+        # always dropped (drop_last=False would break jit compilation caching)
+        self.batches_per_epoch = len(dataset) // per_step
+        if not drop_last:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "drop_last=False is not supported on TPU (static shapes); "
+                "the trailing partial batch is dropped"
+            )
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.batches_per_epoch
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        per_step = self.micro_batch_size * self.dp_world_size * self.gas
+        for start in range(0, (n // per_step) * per_step, per_step):
+            idx = order[start : start + per_step]
+            if not self.global_batches:
+                # deterministic per-rank interleave (reference uses
+                # DistributedSampler semantics: rank-strided)
+                idx = idx.reshape(self.gas, self.dp_world_size, self.micro_batch_size)[
+                    :, self.dp_rank
+                ].reshape(-1)
+            samples = [self.dataset[int(i)] for i in idx]
+            batch = self.collate_fn(samples)
+            gas_fold = lambda x: x.reshape((self.gas, x.shape[0] // self.gas) + x.shape[1:])
+            import jax
+
+            yield jax.tree_util.tree_map(gas_fold, batch)
+        self.epoch += 1
+
+
+def _default_collate(samples: Sequence[Any]):
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *samples)

@@ -1,0 +1,512 @@
+"""The training engine: DeepSpeed's ``DeepSpeedEngine`` re-imagined for XLA.
+
+The reference engine (``runtime/engine.py:184 DeepSpeedEngine``, 3,884 LoC)
+orchestrates fwd/bwd/step imperatively: grad hooks, bucketed allreduce,
+stream juggling, loss scaling, GAS boundaries.  Here the entire training step
+— gradient-accumulation loop, mixed precision, ZeRO reduce-scatter /
+all-gather, loss scaling, clipping, optimizer update, LR schedule — is one
+jit-compiled function over a named mesh; XLA generates the collective
+schedule from the ZeRO sharding plan (see ``runtime/zero.py``).
+
+API parity with the reference:
+
+- ``engine(batch)`` / ``engine.forward``  (engine.py:1926)
+- ``engine.backward(loss)``               (engine.py:2085)
+- ``engine.step()``                       (engine.py:2282)
+- ``engine.train_batch(data_iter)``       (pipe/engine.py:338 — offered on the
+  base engine too, as the recommended fused path)
+- ``engine.eval_batch``, ``engine.save_checkpoint``, ``engine.load_checkpoint``,
+  ``engine.global_steps``, ``engine.get_lr``, ``engine.gradient_accumulation_steps()``
+
+The forward/backward/step triple is preserved by a micro-batch staging shim:
+``forward`` runs the jitted value-and-grad on the staged micro-batch and
+caches gradients, ``backward`` accumulates them into a (ZeRO-sharded) buffer,
+``step`` applies the update at the GAS boundary — same user-visible contract
+(including ``is_gradient_accumulation_boundary``, engine.py:2166) without
+eager autograd.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm import comm as dist
+from ..config.config import Config, parse_config
+from ..ops.optimizers import build_optimizer
+from ..parallel.topology import (
+    BATCH_AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    Grid,
+    MeshSpec,
+    initialize_mesh,
+)
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (
+    BACKWARD_GLOBAL_TIMER,
+    FORWARD_GLOBAL_TIMER,
+    STEP_GLOBAL_TIMER,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+)
+from . import precision, zero
+from .lr_schedules import LRScheduler, get_lr_schedule_fn
+
+
+class TrainState(NamedTuple):
+    """All mutable training state, as one pytree carried through jit."""
+
+    step: jnp.ndarray  # i32 global step
+    params: Any  # fp32 master params (ZeRO-sharded per plan)
+    opt_state: Any
+    loss_scale: precision.LossScaleState
+
+
+class StepMetrics(NamedTuple):
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+    skipped: jnp.ndarray  # bool — fp16 overflow skipped the update
+
+
+class DeepSpeedTpuEngine:
+    """Wraps a loss function + params into a sharded, jitted training loop.
+
+    Contract: ``loss_fn(params, batch, rng) -> scalar loss`` — a pure function
+    of the *compute-dtype* params.  ``models/`` provides adapters that build
+    this from flax modules.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        config: Config,
+        grid: Grid,
+        tp_rules=None,
+        eval_fn: Optional[Callable] = None,
+        seed: Optional[int] = None,
+        remat_policy: Optional[str] = None,
+    ):
+        self.config = config
+        self.grid = grid
+        self.mesh = grid.mesh
+        self.loss_fn = loss_fn
+        self.eval_fn = eval_fn
+        self.tp_rules = tp_rules
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size,
+            steps_per_output=config.steps_per_print,
+        )
+        self.monitor = None  # attached by initialize()
+        self.lr_schedule_fn = self._build_lr_schedule()
+        self.lr_scheduler = LRScheduler(self.lr_schedule_fn)
+        self.optimizer = build_optimizer(
+            config.optimizer.type, config.optimizer.params, learning_rate=self.lr_schedule_fn
+        )
+        self.compute_dtype = precision.compute_dtype(config.precision_dtype)
+        self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
+
+        # ---- sharding plan ----
+        shapes = jax.eval_shape(lambda p: p, params)
+        self.plan = zero.plan_sharding(shapes, config.zero_optimization, grid.spec, tp_rules)
+        self.param_shardings = self.plan.param_shardings(self.mesh)
+        self.master_shardings = self.plan.master_shardings(self.mesh)
+        self._scalar_sharding = NamedSharding(self.mesh, P())
+
+        # ---- place master params + init optimizer state, sharded at creation ----
+        place_masters = jax.jit(
+            lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p),
+            out_shardings=self.master_shardings,
+        )
+        master_params = place_masters(params)
+        opt_shapes = jax.eval_shape(self.optimizer.init, master_params)
+        self.opt_shardings = self.plan.opt_state_shardings(self.mesh, opt_shapes)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(
+            master_params
+        )
+
+        fp16 = config.fp16.enabled
+        loss_scale_state = precision.init_loss_scale(
+            dynamic=fp16 and config.fp16.loss_scale == 0,
+            initial_scale_power=config.fp16.initial_scale_power,
+            static_scale=config.fp16.loss_scale if fp16 else 1.0,
+            hysteresis=config.fp16.hysteresis,
+        )
+        loss_scale_state = jax.device_put(
+            loss_scale_state,
+            jax.tree_util.tree_map(lambda _: self._scalar_sharding, loss_scale_state),
+        )
+        self.state = TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), self._scalar_sharding),
+            params=master_params,
+            opt_state=opt_state,
+            loss_scale=loss_scale_state,
+        )
+        self.state_shardings = TrainState(
+            step=self._scalar_sharding,
+            params=self.master_shardings,
+            opt_state=self.opt_shardings,
+            loss_scale=jax.tree_util.tree_map(
+                lambda _: self._scalar_sharding, loss_scale_state
+            ),
+        )
+
+        self._train_step = None  # built lazily (needs batch sharding)
+        self._grad_fn = None
+        self._apply_fn = None
+        self._eval_step = None
+        # forward/backward/step shim state
+        self._pending: Optional[Dict[str, Any]] = None
+        self._grad_buffer = None
+        self._micro_steps = 0
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self._last_metrics: Optional[StepMetrics] = None
+        log_dist(
+            f"engine ready: zero_stage={config.zero_optimization.stage} "
+            f"mesh={grid.spec.sizes} dtype={config.precision_dtype} "
+            f"micro_batch={config.train_micro_batch_size_per_gpu} "
+            f"gas={config.gradient_accumulation_steps}"
+        )
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def _build_lr_schedule(self):
+        sched = self.config.scheduler
+        if sched.type is None and "lr" in (self.config.optimizer.params or {}):
+            base = float(self.config.optimizer.params["lr"])
+            return lambda step: jnp.asarray(base, jnp.float32)
+        return get_lr_schedule_fn(sched.type, sched.params)
+
+    def batch_sharding(self, batch, batch_dim: int = 0):
+        """Shard the batch dim of every leaf over the DP axes.  The fused
+        train path stacks micro-batches as ``[gas, global_micro, ...]`` so its
+        batch dim is 1; the forward() shim takes bare micro-batches (dim 0)."""
+        def spec_for(x):
+            nd = getattr(x, "ndim", 0)
+            if nd <= batch_dim:
+                return NamedSharding(self.mesh, P())
+            entries = [None] * nd
+            entries[batch_dim] = BATCH_AXES
+            return NamedSharding(self.mesh, P(*entries))
+
+        return jax.tree_util.tree_map(spec_for, batch)
+
+    # ------------------------------------------------------------------
+    # the jitted train step
+    # ------------------------------------------------------------------
+    def _micro_value_and_grad(self, master_params, micro_batch, rng, scale):
+        """Loss+grads for one micro-batch, w.r.t. fp32 masters, computed
+        through compute-dtype casts (the BF16_Optimizer linkage, bf16_optimizer.py:34)."""
+
+        def scaled_loss(p):
+            cp = precision.cast_floating(p, self.compute_dtype)
+            cp = zero.constrain(cp, self.param_shardings)
+            loss = self.loss_fn(cp, micro_batch, rng)
+            return loss * scale
+
+        loss, grads = jax.value_and_grad(scaled_loss)(master_params)
+        return loss / scale, grads
+
+    def _apply_grads(self, state: TrainState, grad_sum, divisor):
+        """Shared epilogue of both step paths: unscale, overflow check, clip,
+        optimizer update, overflow-skip select, loss-scale update.  ``grad_sum``
+        is the (possibly accumulated) fp32 gradient pytree; ``divisor`` folds
+        in the loss scale and any GAS averaging."""
+        cfg = self.config
+        fp16 = cfg.fp16.enabled
+        dynamic = fp16 and cfg.fp16.loss_scale == 0
+        clip = cfg.gradient_clipping
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / divisor, grad_sum
+        )
+        finite = precision.grads_finite(grads) if fp16 else jnp.asarray(True)
+        grad_norm = precision.global_grad_norm(grads)
+        if clip and clip > 0:
+            grads, grad_norm = precision.clip_by_global_norm(grads, clip, grad_norm)
+        updates, new_opt_state = self.optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        if fp16:
+            sel = lambda a, b: jax.tree_util.tree_map(
+                lambda x, y: jnp.where(finite, x, y), a, b
+            )
+            new_params = sel(new_params, state.params)
+            new_opt_state = sel(new_opt_state, state.opt_state)
+            new_scale_state = (
+                precision.update_loss_scale(
+                    state.loss_scale,
+                    finite,
+                    loss_scale_window=cfg.fp16.loss_scale_window,
+                    min_scale=cfg.fp16.min_loss_scale,
+                    init_hysteresis=cfg.fp16.hysteresis,
+                )
+                if dynamic
+                else state.loss_scale
+            )
+        else:
+            new_scale_state = state.loss_scale
+        new_state = TrainState(
+            step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
+            params=new_params,
+            opt_state=new_opt_state,
+            loss_scale=new_scale_state,
+        )
+        return new_state, grad_norm, finite
+
+    def _make_train_step(self):
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+
+        def train_step(state: TrainState, batch, rng):
+            scale = state.loss_scale.scale if fp16 else jnp.asarray(1.0, jnp.float32)
+            divisor = scale
+
+            def one_micro(p, micro, r):
+                loss, grads = self._micro_value_and_grad(p, micro, r, scale)
+                grads = zero.constrain(grads, self.master_shardings)
+                return loss, grads
+
+            if gas == 1:
+                micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+                loss, grads = one_micro(state.params, micro, rng)
+            else:
+                # lax.scan over the gas dimension: grads accumulate in fp32 in
+                # the *master* (ZeRO-sharded) layout, so accumulation memory is
+                # already partitioned — the analogue of the reference's
+                # contiguous sharded gradient buffer (stage_1_and_2.py).
+                def body(carry, inp):
+                    acc, lsum = carry
+                    micro, r = inp
+                    loss, grads = one_micro(state.params, micro, r)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return (acc, lsum + loss), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), state.params
+                )
+                rngs = jax.random.split(rng, gas)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32)), (batch, rngs)
+                )
+                loss = loss_sum / gas
+                divisor = scale * gas  # fold GAS averaging into the unscale divisor
+
+            # fp16 overflow handling (reference: fp16/loss_scaler.py overflow
+            # path + engine.py skipped-step count) lives in _apply_grads.
+            new_state, grad_norm, finite = self._apply_grads(state, grads, divisor)
+            metrics = StepMetrics(
+                loss=loss,
+                grad_norm=grad_norm,
+                lr=jnp.asarray(self.lr_schedule_fn(state.step), jnp.float32),
+                loss_scale=scale,
+                skipped=jnp.logical_not(finite),
+            )
+            return new_state, metrics
+
+        return train_step
+
+    def _get_train_step(self, batch):
+        if self._train_step is None:
+            step_fn = self._make_train_step()
+            metrics_shardings = StepMetrics(
+                *([self._scalar_sharding] * len(StepMetrics._fields))
+            )
+            self._train_step = jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, self.batch_sharding(batch, batch_dim=1), None),
+                out_shardings=(self.state_shardings, metrics_shardings),
+                donate_argnums=(0,),
+            )
+        return self._train_step
+
+    # ------------------------------------------------------------------
+    # public API — fused path
+    # ------------------------------------------------------------------
+    def train_batch(self, batch) -> jnp.ndarray:
+        """Run one full optimizer step on a global batch shaped
+        ``[gas, global_micro_batch, ...]`` (or ``[global_micro_batch, ...]``
+        when gradient_accumulation_steps == 1)."""
+        gas = self.config.gradient_accumulation_steps
+        leading = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if leading != gas:
+            # accept flat [global_batch, ...] and fold into [gas, micro, ...]
+            batch = jax.tree_util.tree_map(
+                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch
+            )
+        self.tput_timer.start()
+        self.timers(STEP_GLOBAL_TIMER).start()
+        rng = self._next_rng()
+        self.state, metrics = self._get_train_step(batch)(self.state, batch, rng)
+        self._last_metrics = metrics
+        self.global_steps += 1
+        if self.config.fp16.enabled and bool(metrics.skipped):
+            self.skipped_steps += 1
+        self.lr_scheduler.step()
+        self.timers(STEP_GLOBAL_TIMER).stop(
+            sync_obj=metrics.loss if self.config.wall_clock_breakdown else None
+        )
+        self.tput_timer.stop(sync_obj=metrics.loss)
+        self._emit_monitor(metrics)
+        return metrics.loss
+
+    # ------------------------------------------------------------------
+    # public API — forward/backward/step parity shim
+    # ------------------------------------------------------------------
+    def forward(self, batch):
+        """Stage a micro-batch; returns its loss (reference engine.py:1926)."""
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self._grad_fn is None:
+            def micro_step(state, micro, rng):
+                scale = (
+                    state.loss_scale.scale
+                    if self.config.fp16.enabled
+                    else jnp.asarray(1.0, jnp.float32)
+                )
+                loss, grads = self._micro_value_and_grad(state.params, micro, rng, scale)
+                grads = zero.constrain(grads, self.master_shardings)
+                return loss, grads
+
+            self._grad_fn = jax.jit(
+                micro_step,
+                in_shardings=(self.state_shardings, self.batch_sharding(batch), None),
+                out_shardings=(self._scalar_sharding, self.master_shardings),
+            )
+        loss, grads = self._grad_fn(self.state, batch, self._next_rng())
+        self._pending = {"grads": grads, "loss": loss}
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def backward(self, loss=None):
+        """Accumulate the staged micro-batch's gradients (engine.py:2085)."""
+        assert self._pending is not None, "backward() without a prior forward()"
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        grads = self._pending["grads"]
+        if self._grad_buffer is None:
+            self._grad_buffer = grads
+        else:
+            self._grad_buffer = jax.tree_util.tree_map(
+                jnp.add, self._grad_buffer, grads
+            )
+        self._micro_steps += 1
+        self._pending = None
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        """reference: engine.py:2166."""
+        return self._micro_steps % self.config.gradient_accumulation_steps == 0
+
+    def step(self):
+        """Apply accumulated gradients at the GAS boundary (engine.py:2282)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self._apply_fn is None:
+            fp16 = self.config.fp16.enabled
+            gas = self.config.gradient_accumulation_steps
+
+            def apply(state: TrainState, grad_sum):
+                scale = state.loss_scale.scale if fp16 else jnp.asarray(1.0, jnp.float32)
+                new_state, _, finite = self._apply_grads(state, grad_sum, scale * gas)
+                return new_state, jnp.logical_not(finite)
+
+            self._apply_fn = jax.jit(
+                apply,
+                in_shardings=(self.state_shardings, self.master_shardings),
+                out_shardings=(self.state_shardings, self._scalar_sharding),
+                donate_argnums=(0, 1),
+            )
+        self.state, skipped = self._apply_fn(self.state, self._grad_buffer)
+        self._grad_buffer = None
+        self.global_steps += 1
+        if bool(skipped):
+            self.skipped_steps += 1
+        self.lr_scheduler.step()
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------
+    # eval / inference
+    # ------------------------------------------------------------------
+    def eval_batch(self, batch):
+        if self._eval_step is None:
+            fn = self.eval_fn or self.loss_fn
+
+            def ev(state, b, rng):
+                cp = precision.cast_floating(state.params, self.compute_dtype)
+                cp = zero.constrain(cp, self.param_shardings)
+                return fn(cp, b, rng)
+
+            self._eval_step = jax.jit(ev)
+        return self._eval_step(self.state, batch, self._next_rng())
+
+    # ------------------------------------------------------------------
+    # misc parity API
+    # ------------------------------------------------------------------
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def get_lr(self):
+        return self.lr_scheduler.get_last_lr()
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        return float(self._last_metrics.grad_norm) if self._last_metrics else None
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state.loss_scale.scale)
+
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    @property
+    def dp_world_size(self) -> int:
+        return self.grid.dp_world_size
+
+    def module_params(self):
+        """Compute-dtype view of the current parameters."""
+        return precision.cast_floating(self.state.params, self.compute_dtype)
+
+    def _emit_monitor(self, metrics: StepMetrics):
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(
+                f"step={self.global_steps} loss={float(metrics.loss):.4f} "
+                f"lr={float(metrics.lr):.3e} grad_norm={float(metrics.grad_norm):.3f}"
+            )
+        if self.monitor is not None and self.monitor.enabled:
+            self.monitor.write_events(
+                [
+                    ("Train/Samples/train_loss", float(metrics.loss), self.global_steps),
+                    ("Train/Samples/lr", float(metrics.lr), self.global_steps),
+                    (
+                        "Train/Samples/loss_scale",
+                        float(metrics.loss_scale),
+                        self.global_steps,
+                    ),
+                ]
+            )
+
+    # checkpointing is provided by deepspeed_tpu.checkpoint; engine methods
+    # delegate so the reference API shape survives.
+    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        from ..checkpoint.saving import save_checkpoint as _save
+
+        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+
+    def load_checkpoint(self, load_dir, tag=None, **kw):
+        from ..checkpoint.saving import load_checkpoint as _load
+
+        return _load(self, load_dir, tag=tag, **kw)

@@ -1,0 +1,166 @@
+"""LR schedules: LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR.
+
+TPU-native counterpart of the reference's ``runtime/lr_schedules.py`` (~900
+LoC).  Each schedule is a pure ``step -> lr`` function (optax-style) so it can
+live inside the jitted train step; a thin ``LRScheduler`` class preserves the
+reference's ``step()/get_last_lr()/state_dict()`` object API for user code.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+def lr_range_test(
+    lr_range_test_min_lr: float = 1e-3,
+    lr_range_test_step_size: int = 2000,
+    lr_range_test_step_rate: float = 1.0,
+    lr_range_test_staircase: bool = False,
+    **_,
+) -> Callable:
+    def fn(step):
+        interval = (
+            jnp.floor(step / lr_range_test_step_size)
+            if lr_range_test_staircase
+            else step / lr_range_test_step_size
+        )
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return fn
+
+
+def warmup_lr(
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 1e-3,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+    **_,
+) -> Callable:
+    def fn(step):
+        frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+        if warmup_type == "log":
+            # log(1+frac*(e-1)) ramp, matching reference's log warmup
+            gamma = jnp.log1p(frac * (math.e - 1.0))
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+
+    return fn
+
+
+def warmup_decay_lr(
+    total_num_steps: int,
+    warmup_min_lr: float = 0.0,
+    warmup_max_lr: float = 1e-3,
+    warmup_num_steps: int = 1000,
+    warmup_type: str = "log",
+    **_,
+) -> Callable:
+    wu = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def fn(step):
+        decay_frac = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
+        )
+        return jnp.where(step < warmup_num_steps, wu(step), warmup_max_lr * decay_frac)
+
+    return fn
+
+
+def warmup_cosine_lr(
+    total_num_steps: int,
+    warmup_min_ratio: float = 0.0,
+    warmup_num_steps: int = 1000,
+    cos_min_ratio: float = 1e-4,
+    lr: float = 1e-3,
+    **_,
+) -> Callable:
+    def fn(step):
+        wu_frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+        warm = (warmup_min_ratio + (1 - warmup_min_ratio) * wu_frac) * lr
+        progress = jnp.clip(
+            (step - warmup_num_steps) / max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0
+        )
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_num_steps, warm, cos * lr)
+
+    return fn
+
+
+def one_cycle(
+    cycle_min_lr: float = 1e-4,
+    cycle_max_lr: float = 1e-3,
+    cycle_first_step_size: int = 2000,
+    cycle_second_step_size: Optional[int] = None,
+    decay_step_size: int = 0,
+    decay_lr_rate: float = 0.0,
+    **_,
+) -> Callable:
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    cycle_len = cycle_first_step_size + second
+
+    def fn(step):
+        up_frac = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down_frac = jnp.clip((step - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        in_cycle_lr = jnp.where(
+            step < cycle_first_step_size,
+            cycle_min_lr + (cycle_max_lr - cycle_min_lr) * up_frac,
+            cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac,
+        )
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - cycle_len, 0) / decay_step_size
+            decay = 1.0 / (1.0 + decay_lr_rate * decay_steps)
+        else:
+            decay = 1.0
+        return jnp.where(step < cycle_len, in_cycle_lr, cycle_min_lr * decay)
+
+    return fn
+
+
+_FACTORIES = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+}
+
+
+def get_lr_schedule_fn(type_name: Optional[str], params: Dict[str, Any]) -> Callable:
+    """Build a pure step->lr function from a config scheduler block."""
+    if type_name is None:
+        base = float(params.get("lr", 1e-3)) if params else 1e-3
+        return lambda step: jnp.asarray(base, jnp.float32)
+    if type_name not in _FACTORIES:
+        raise ValueError(f"unknown scheduler {type_name}; valid: {VALID_LR_SCHEDULES}")
+    return _FACTORIES[type_name](**params)
+
+
+class LRScheduler:
+    """Object API shim preserving the reference's scheduler interface."""
+
+    def __init__(self, schedule_fn: Callable, last_step: int = 0):
+        self.schedule_fn = schedule_fn
+        self.last_step = last_step
+
+    def step(self, increment: int = 1):
+        self.last_step += increment
+
+    def get_last_lr(self) -> List[float]:
+        return [float(self.schedule_fn(self.last_step))]
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.last_step = int(sd["last_step"])

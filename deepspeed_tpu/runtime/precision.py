@@ -1,0 +1,117 @@
+"""Mixed precision: bf16/fp16 policies + dynamic loss scaling.
+
+TPU-native counterpart of the reference's ``runtime/bf16_optimizer.py:34
+BF16_Optimizer`` and ``runtime/fp16/loss_scaler.py:42
+LossScaler/DynamicLossScaler``.  On TPU the idiomatic scheme is fp32 master
+params + bf16 compute (cast at use), which is exactly the reference's BF16
+optimizer design minus the manual flat-buffer bookkeeping — jit + sharding
+make the fp32<->bf16 link implicit.  fp16 with dynamic loss scaling is kept
+for parity; the scaler state is a pytree carried through the jitted step so
+scale updates stay on-device.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def compute_dtype(name: str):
+    return DTYPES[name]
+
+
+def cast_floating(tree, dtype):
+    """Cast floating leaves of a pytree; leaves ints alone."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss scaler state (reference: fp16/loss_scaler.py:42).
+
+    For bf16/fp32 this degenerates to a static scale of 1 and never updates.
+    """
+
+    scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar
+    hysteresis: jnp.ndarray  # i32 scalar
+
+
+def init_loss_scale(
+    dynamic: bool,
+    initial_scale_power: int = 16,
+    static_scale: float = 1.0,
+    hysteresis: int = 2,
+) -> LossScaleState:
+    scale = float(2 ** initial_scale_power) if dynamic else float(static_scale or 1.0)
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+    )
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.asarray(True)
+    for leaf in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+    return finite
+
+
+def update_loss_scale(
+    state: LossScaleState,
+    finite: jnp.ndarray,
+    loss_scale_window: int = 1000,
+    scale_factor: float = 2.0,
+    min_scale: float = 1.0,
+    max_scale: float = 2.0 ** 24,
+    init_hysteresis: int = 2,
+) -> LossScaleState:
+    """DynamicLossScaler.update_scale (reference fp16/loss_scaler.py:143):
+    on overflow, consume hysteresis then halve; after ``loss_scale_window``
+    clean steps, double."""
+    def on_finite(s: LossScaleState) -> LossScaleState:
+        good = s.good_steps + 1
+        grow = good >= loss_scale_window
+        new_scale = jnp.where(grow, jnp.minimum(s.scale * scale_factor, max_scale), s.scale)
+        return LossScaleState(new_scale, jnp.where(grow, 0, good), s.hysteresis)
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hys = s.hysteresis - 1
+        shrink = hys <= 0
+        new_scale = jnp.where(shrink, jnp.maximum(s.scale / scale_factor, min_scale), s.scale)
+        new_hys = jnp.where(shrink, jnp.asarray(init_hysteresis, jnp.int32), hys)
+        return LossScaleState(new_scale, jnp.zeros_like(s.good_steps), new_hys)
+
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(finite, a, b), on_finite(state), on_overflow(state)
+    )
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    """reference: runtime/utils.py:826 get_global_norm_of_tensors (L2)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(grads, max_norm: float, norm: jnp.ndarray = None):
+    """reference: runtime/utils.py:315 clip_grad_norm_."""
+    if norm is None:
+        norm = global_grad_norm(grads)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), grads), norm

@@ -1,0 +1,2 @@
+from .logging import logger, log_dist  # noqa: F401
+from .timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401

@@ -1,0 +1,71 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``
+(``logger``/``log_dist``): rank filtering keyed off ``jax.process_index()``
+instead of ``torch.distributed`` ranks.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(os.environ.get("DSTPU_LOG_LEVEL", "").upper() or level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            )
+        )
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialized yet
+        return int(os.environ.get("DSTPU_PROCESS_INDEX", 0))
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` logs on every process.  Mirrors the reference API
+    (``deepspeed/utils/logging.py log_dist``).
+    """
+    ranks = ranks if ranks is not None else [0]
+    my_rank = _process_index()
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warn_cache = getattr(warning_once, "_cache", None)
+    if _warn_cache is None:
+        _warn_cache = set()
+        warning_once._cache = _warn_cache
+    if message not in _warn_cache:
+        _warn_cache.add(message)
+        logger.warning(message)

@@ -1,0 +1,41 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+The reference tests distributed logic without a cluster by spawning local
+processes over a file-store rendezvous (``tests/unit/common.py:129
+DistributedExec``).  The JAX analogue is simpler and faster: force the CPU
+platform with 8 virtual devices (``--xla_force_host_platform_device_count``)
+so every mesh shape up to 8 is testable in-process — same coverage philosophy
+(multi-node is never tested directly in CI; a local many-device world is the
+proxy).
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def make_grid(**axes):
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+
+    return initialize_mesh(**axes)
+
+
+@pytest.fixture
+def grid8():
+    return make_grid(fsdp=8)

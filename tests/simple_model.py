@@ -1,0 +1,64 @@
+"""Tiny synthetic models for unit tests.
+
+Mirrors the role of the reference's ``tests/unit/simple_model.py``
+(SimpleModel with hidden_dim≈10): small pure-jax models with deterministic
+data, used to check engine/ZeRO/parallelism numerics quickly on the CPU mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mlp(rng, in_dim=8, hidden=16, out_dim=8, n_layers=2, dtype=jnp.float32):
+    dims = [in_dim] + [hidden] * (n_layers - 1) + [out_dim]
+    params = {}
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        rng, k = jax.random.split(rng)
+        params[f"layer_{i}"] = {
+            "kernel": (jax.random.normal(k, (a, b)) / np.sqrt(a)).astype(dtype),
+            "bias": jnp.zeros((b,), dtype),
+        }
+    return params
+
+
+def mlp_forward(params, x):
+    n = len(params)
+    for i in range(n):
+        layer = params[f"layer_{i}"]
+        x = x @ layer["kernel"] + layer["bias"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, batch, rng):
+    pred = mlp_forward(params, batch["x"])
+    return jnp.mean((pred - batch["y"].astype(pred.dtype)) ** 2)
+
+
+def random_batches(n_steps, gas, micro_global, in_dim=8, out_dim=8, seed=0):
+    """[gas, micro_global, dim] batches with a fixed linear target."""
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(in_dim, out_dim).astype(np.float32)
+    out = []
+    for _ in range(n_steps):
+        x = rs.randn(gas, micro_global, in_dim).astype(np.float32)
+        y = x @ w_true
+        out.append({"x": x, "y": y})
+    return out
+
+
+class ArrayDataset:
+    """Indexable dataset of (x, y) dicts for dataloader tests."""
+
+    def __init__(self, n=256, in_dim=8, out_dim=8, seed=0):
+        rs = np.random.RandomState(seed)
+        self.x = rs.randn(n, in_dim).astype(np.float32)
+        w = rs.randn(in_dim, out_dim).astype(np.float32)
+        self.y = self.x @ w
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}

@@ -1,0 +1,122 @@
+"""Config parsing + batch triangulation tests (reference:
+tests/unit/runtime/test_ds_config_dict.py pattern)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.config import Config, ConfigError, parse_config
+
+
+def test_batch_triangulation_all_given():
+    cfg = parse_config(
+        {
+            "train_batch_size": 32,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+        },
+        dp_world_size=8,
+    )
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_invariant_violation():
+    with pytest.raises(ConfigError):
+        parse_config(
+            {
+                "train_batch_size": 33,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+            },
+            dp_world_size=8,
+        )
+
+
+def test_batch_derive_gas():
+    cfg = parse_config(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 2}, dp_world_size=8
+    )
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_derive_micro():
+    cfg = parse_config(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 4}, dp_world_size=8
+    )
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_only_micro_given():
+    cfg = parse_config({"train_micro_batch_size_per_gpu": 3}, dp_world_size=4)
+    assert cfg.train_batch_size == 12
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_reference_style_json_accepted():
+    """A real DeepSpeed JSON should parse (ignored keys dropped)."""
+    ds_json = {
+        "train_batch_size": 16,
+        "steps_per_print": 2000,
+        "optimizer": {
+            "type": "Adam",
+            "params": {"lr": 0.001, "betas": [0.8, 0.999], "eps": 1e-8, "weight_decay": 3e-7},
+        },
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001, "warmup_num_steps": 1000},
+        },
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "bf16": {"enabled": True},
+        "fp16": {"enabled": False},
+        "wall_clock_breakdown": False,
+        "zero_optimization": {
+            "stage": 2,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+            "offload_optimizer": {"device": "none"},
+        },
+        "zero_allow_untested_optimizer": True,
+    }
+    cfg = parse_config(ds_json, dp_world_size=8)
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.zero_optimization.offload_optimizer is None
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_json_string_and_unknown_key():
+    cfg = parse_config(json.dumps({"train_batch_size": 8}), dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 1
+    with pytest.raises(ConfigError):
+        parse_config({"zero_optimization": {"not_a_key": 1}})
+
+
+def test_fp16_bf16_mutually_exclusive():
+    with pytest.raises(ConfigError):
+        parse_config(
+            {"fp16": {"enabled": True}, "bf16": {"enabled": True}}, dp_world_size=1
+        )
+
+
+def test_zero_stage_bounds():
+    with pytest.raises(ConfigError):
+        parse_config({"zero_optimization": {"stage": 4}})
+
+
+def test_only_gas_given():
+    cfg = parse_config({"gradient_accumulation_steps": 4}, dp_world_size=2)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_batch_size == 8
+
+
+def test_nested_auto_stripped():
+    cfg = parse_config(
+        {"optimizer": {"type": "adamw", "params": {"lr": "auto"}},
+         "train_micro_batch_size_per_gpu": "auto"},
+        dp_world_size=2,
+    )
+    assert "lr" not in cfg.optimizer.params
+    assert cfg.train_micro_batch_size_per_gpu == 1

@@ -1,0 +1,43 @@
+"""Mesh/topology tests (reference: unit tests over utils/groups.py +
+runtime/pipe/topology.py)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    MeshSpec,
+    build_mesh,
+    infer_spec,
+    initialize_mesh,
+)
+
+
+def test_infer_spec_leftover_to_data():
+    s = infer_spec(8, fsdp=4)
+    assert s.data == 2 and s.fsdp == 4
+    assert s.world_size == 8
+
+
+def test_infer_spec_not_divisible():
+    with pytest.raises(ValueError):
+        infer_spec(8, model=3)
+
+
+def test_mesh_has_all_axes():
+    grid = initialize_mesh(fsdp=4, model=2)
+    assert set(grid.mesh.axis_names) == {"data", "fsdp", "model", "seq", "expert", "stage"}
+    assert grid.mesh.shape["fsdp"] == 4
+    assert grid.mesh.shape["model"] == 2
+    assert grid.dp_world_size == 4
+
+
+def test_grid_sizes():
+    grid = initialize_mesh(data=2, seq=4)
+    assert grid.sequence_parallel_size == 4
+    assert grid.dp_world_size == 2
+    assert grid.world_size == 8
+    assert grid.pipe_parallel_size == 1
+
+
+def test_mesh_wrong_world_size():
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(data=16))
