@@ -1,0 +1,11 @@
+from .transformer import (  # noqa: F401
+    CausalLM,
+    TransformerConfig,
+    cross_entropy_loss,
+    forward,
+    init_kv_cache,
+    init_params,
+    set_current_mesh,
+    tp_rules,
+)
+from .presets import get_preset, list_presets  # noqa: F401

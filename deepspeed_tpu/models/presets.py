@@ -1,0 +1,86 @@
+"""Named architecture presets covering the reference's supported families.
+
+The reference enumerates supported model families in its kernel-injection
+policies (module_inject/containers/: bert, bloom, gpt2, gptj, gptneox, llama,
+llama2, opt, megatron, ...) and inference-v2 implementations
+(inference/v2/model_implementations/{llama_v2,mistral,mixtral,falcon,opt,phi,
+qwen,...}).  Here each family is a ``TransformerConfig`` preset; smaller
+"*_proxy" configs keep the exact architecture shape but scale width/depth for
+single-chip benchmarking and tests.
+"""
+from __future__ import annotations
+
+from .transformer import TransformerConfig
+
+_REGISTRY = {}
+
+
+def register(name: str, cfg: TransformerConfig) -> TransformerConfig:
+    _REGISTRY[name] = cfg
+    return cfg
+
+
+def get_preset(name: str, **overrides) -> TransformerConfig:
+    cfg = _REGISTRY[name]
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def list_presets():
+    return sorted(_REGISTRY)
+
+
+# --- Llama family (RMSNorm + RoPE + SwiGLU (+GQA for v3)) -------------------
+register("llama2_7b", TransformerConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=11008, num_layers=32,
+    num_heads=32, num_kv_heads=32, max_seq_len=4096, rope_theta=10_000.0,
+    remat="dots", attn_impl="auto"))
+register("llama3_8b", TransformerConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=8192, rope_theta=500_000.0,
+    remat="dots", attn_impl="auto"))
+register("llama3_70b", TransformerConfig(
+    vocab_size=128256, hidden_size=8192, intermediate_size=28672, num_layers=80,
+    num_heads=64, num_kv_heads=8, max_seq_len=8192, rope_theta=500_000.0,
+    remat="full", attn_impl="auto"))
+
+# ~410M-param Llama-3-shaped proxy: same GQA ratio/norm/act, fits one v5e chip
+# with fp32 masters + Adam state.  This is the bench.py flagship workload.
+register("llama3_proxy_410m", TransformerConfig(
+    vocab_size=32128, hidden_size=1024, intermediate_size=4096, num_layers=24,
+    num_heads=16, num_kv_heads=4, max_seq_len=4096, rope_theta=500_000.0,
+    remat="dots", attn_impl="auto"))
+
+# --- Mistral / Mixtral ------------------------------------------------------
+register("mistral_7b", TransformerConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=8192, rope_theta=10_000.0,
+    remat="dots", attn_impl="auto"))
+register("mixtral_8x7b", TransformerConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=8192, rope_theta=1_000_000.0,
+    moe_num_experts=8, moe_top_k=2, remat="full", attn_impl="auto"))
+
+# --- GPT-2 (LayerNorm + learned positions + GELU, tied embeddings) ----------
+register("gpt2_small", TransformerConfig(
+    vocab_size=50257, hidden_size=768, intermediate_size=3072, num_layers=12,
+    num_heads=12, num_kv_heads=12, max_seq_len=1024, norm="layernorm",
+    activation="gelu", gated_mlp=False, position="learned", tie_embeddings=True))
+
+# --- Qwen-2 style (qkv bias) ------------------------------------------------
+register("qwen2_7b", TransformerConfig(
+    vocab_size=152064, hidden_size=3584, intermediate_size=18944, num_layers=28,
+    num_heads=28, num_kv_heads=4, max_seq_len=8192, rope_theta=1_000_000.0,
+    qkv_bias=True, remat="dots", attn_impl="auto"))
+
+# --- tiny configs for tests -------------------------------------------------
+register("tiny", TransformerConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=128))
+register("tiny_moe", TransformerConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=128,
+    moe_num_experts=4, moe_top_k=2))
+register("tiny_gpt2", TransformerConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=4, max_seq_len=128, norm="layernorm",
+    activation="gelu", gated_mlp=False, position="learned", tie_embeddings=True))

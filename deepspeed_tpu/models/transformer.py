@@ -1,0 +1,488 @@
+"""Flagship decoder-only transformer (Llama family), TPU-first.
+
+The reference has no model zoo for *training* (users bring nn.Modules; the
+kernel-injection containers in ``module_inject/containers/`` and the
+inference-v2 model implementations ``inference/v2/model_implementations/``
+enumerate the supported families).  Our framework ships a first-class model
+family instead, because on TPU the model and its sharding are designed
+together.  Architecture knobs cover the reference's supported families:
+Llama/Llama-2/Llama-3 (RMSNorm+RoPE+SwiGLU+GQA), Mistral, GPT-2/NeoX-style
+(LayerNorm+learned-pos+GELU), Qwen (qkv bias), and — with
+``moe_num_experts>0`` — Mixtral-style MoE blocks (deepspeed_tpu/moe/).
+
+TPU-native design decisions:
+- **Stacked layer parameters + ``lax.scan``**: all L layers' weights are one
+  pytree with a leading layer dimension, so the decoder is a single scanned
+  block — one trace, O(1) compile time in depth, and pipeline stages are
+  contiguous slices of the stacked arrays (runtime/pipeline/).
+- **Remat policies** (``remat='none'|'full'|'dots'``) replace the reference's
+  activation-checkpointing module (runtime/activation_checkpointing/
+  checkpointing.py:488): ``jax.checkpoint`` over the scanned block.
+- **Sharding by rule, not surgery**: ``tp_rules()`` returns regex→PartitionSpec
+  megatron-style rules consumed by the ZeRO planner (runtime/zero.py),
+  replacing AutoTP module replacement (module_inject/auto_tp.py:193).
+- Everything static-shaped; attention body is pluggable (ops/attention.py)
+  so Ulysses / ring / flash compose without touching the model.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1408
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 8  # < num_heads => GQA (Llama-3 / Mistral style)
+    head_dim: Optional[int] = None  # default hidden_size // num_heads
+    max_seq_len: int = 2048
+    # architecture switches
+    norm: str = "rmsnorm"  # 'rmsnorm' (llama) | 'layernorm' (gpt2/bert)
+    activation: str = "silu"  # 'silu' (swiglu) | 'gelu' (gpt2: plain mlp)
+    gated_mlp: bool = True
+    position: str = "rope"  # 'rope' | 'learned' | 'none'
+    rope_theta: float = 500_000.0  # llama-3 default; llama-2 used 1e4
+    qkv_bias: bool = False  # qwen-style
+    tie_embeddings: bool = False
+    norm_eps: float = 1e-5
+    logits_soft_cap: Optional[float] = None  # gemma-2 style
+    # MoE (Mixtral): >0 turns the MLP into a top-k routed expert layer
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
+    # training
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"  # 'none' | 'full' | 'dots'
+    attn_impl: str = "reference"  # 'reference' | 'flash' | 'auto'
+    # sequence parallelism: 'none' | 'ulysses' | 'ring'
+    sequence_parallel: str = "none"
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def param_count(self) -> int:
+        d, f, L, v = self.hidden_size, self.intermediate_size, self.num_layers, self.vocab_size
+        hq, hkv, hd = self.num_heads, self.num_kv_heads, self.hd
+        attn = d * hq * hd + 2 * d * hkv * hd + hq * hd * d
+        mlp = (3 if self.gated_mlp else 2) * d * f
+        if self.moe_num_experts > 0:
+            mlp = mlp * self.moe_num_experts + d * self.moe_num_experts
+        per_layer = attn + mlp + 2 * d
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        return L * per_layer + emb + d
+
+
+# ---------------------------------------------------------------------------
+# activation-sharding hints (GSPMD): a lightweight "current mesh" context so
+# models can constrain activations without threading the mesh through every
+# call.  No mesh set -> constraints are no-ops (single-device tests).
+# ---------------------------------------------------------------------------
+_CURRENT_MESH = None
+
+
+def set_current_mesh(mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def shard_activation(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    if _CURRENT_MESH is None:
+        return x
+    from jax.sharding import NamedSharding
+
+    # drop axis entries that don't divide the dimension (tiny test shapes);
+    # real meshes keep the full spec and constraint errors surface loudly
+    sizes = dict(zip(_CURRENT_MESH.axis_names, _CURRENT_MESH.devices.shape))
+
+    def ok(dim, entry):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        import math
+
+        return dim % math.prod(sizes.get(a, 1) for a in axes) == 0
+
+    entries = tuple(
+        e if (e is None or ok(d, e)) else None for d, e in zip(x.shape, tuple(spec))
+    )
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(_CURRENT_MESH, P(*entries))
+    )
+
+
+ACT_SPEC = P((DATA_AXIS, FSDP_AXIS), SEQ_AXIS, None)  # [batch, seq, hidden]
+
+
+# ---------------------------------------------------------------------------
+# initialization
+# ---------------------------------------------------------------------------
+def _dense_init(key, shape, in_axis: int, dtype):
+    fan_in = shape[in_axis]
+    std = 1.0 / np.sqrt(fan_in)
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig, dtype=jnp.float32) -> Params:
+    """Build the parameter pytree.  Layer weights carry a leading ``L`` dim.
+
+    fp32 by default — the engine keeps fp32 masters and casts to
+    ``cfg.dtype`` inside the train step (runtime/precision.py).
+    """
+    d, f, L, v = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.vocab_size
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    ks = jax.random.split(rng, 12)
+
+    def dinit(key, shape, in_axis=-2):
+        return _dense_init(key, shape, in_axis, dtype)
+
+    layers: Params = {
+        "attn": {
+            "wq": dinit(ks[0], (L, d, hq * hd)),
+            "wk": dinit(ks[1], (L, d, hkv * hd)),
+            "wv": dinit(ks[2], (L, d, hkv * hd)),
+            "wo": dinit(ks[3], (L, hq * hd, d)),
+        },
+        "attn_norm": {"scale": jnp.ones((L, d), dtype)},
+        "mlp_norm": {"scale": jnp.ones((L, d), dtype)},
+    }
+    if cfg.qkv_bias:
+        layers["attn"]["bq"] = jnp.zeros((L, hq * hd), dtype)
+        layers["attn"]["bk"] = jnp.zeros((L, hkv * hd), dtype)
+        layers["attn"]["bv"] = jnp.zeros((L, hkv * hd), dtype)
+    if cfg.moe_num_experts > 0:
+        E = cfg.moe_num_experts
+        layers["moe"] = {
+            "router": dinit(ks[4], (L, d, E)),
+            "w_gate": dinit(ks[5], (L, E, d, f)),
+            "w_up": dinit(ks[6], (L, E, d, f)),
+            "w_down": dinit(ks[7], (L, E, f, d)),
+        }
+    else:
+        mlp = {
+            "w_up": dinit(ks[5], (L, d, f)),
+            "w_down": dinit(ks[6], (L, f, d)),
+        }
+        if cfg.gated_mlp:
+            mlp["w_gate"] = dinit(ks[4], (L, d, f))
+        layers["mlp"] = mlp
+
+    params: Params = {
+        "embed": {"embedding": _dense_init(ks[8], (v, d), 1, dtype)},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((d,), dtype)},
+    }
+    if cfg.position == "learned":
+        params["pos_embed"] = {"embedding": _dense_init(ks[9], (cfg.max_seq_len, d), 1, dtype)}
+    if cfg.norm == "layernorm":
+        layers["attn_norm"]["bias"] = jnp.zeros((L, d), dtype)
+        layers["mlp_norm"]["bias"] = jnp.zeros((L, d), dtype)
+        params["final_norm"]["bias"] = jnp.zeros((d,), dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _dense_init(ks[10], (d, v), 0, dtype)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+def norm(x: jnp.ndarray, w: Params, kind: str, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    else:
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        xf = (xf - mu) * jax.lax.rsqrt(var + eps)
+    out = xf.astype(x.dtype) * w["scale"]
+    if "bias" in w:
+        out = out + w["bias"]
+    return out
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary embedding, [b, s, h, d] with per-token ``positions`` [b, s] or [s]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # [b, s, 1, d/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _activation(name: str):
+    return {"silu": jax.nn.silu, "gelu": partial(jax.nn.gelu, approximate=True), "relu": jax.nn.relu}[name]
+
+
+def attention_block(
+    lw: Params,
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    positions: jnp.ndarray,
+    attn_fn: Callable,
+    segment_ids: Optional[jnp.ndarray],
+    cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    cache_index: Optional[jnp.ndarray] = None,
+):
+    """One attention sublayer (no residual). Returns (out, new_cache)."""
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    q = x @ lw["wq"]
+    k = x @ lw["wk"]
+    v = x @ lw["wv"]
+    if cfg.qkv_bias:
+        q, k, v = q + lw["bq"], k + lw["bk"], v + lw["bv"]
+    q = q.reshape(b, s, hq, hd)
+    k = k.reshape(b, s, hkv, hd)
+    v = v.reshape(b, s, hkv, hd)
+    if cfg.position == "rope":
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+    new_cache = None
+    q_offset = 0
+    if cache is not None:
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=1)
+        k, v = ck, cv
+        new_cache = (ck, cv)
+        q_offset = cache_index
+    out = attn_fn(
+        q, k, v, causal=True, q_offset=q_offset,
+        segment_ids=segment_ids,
+        logits_soft_cap=cfg.logits_soft_cap,
+    )
+    out = out.reshape(b, s, hq * hd) @ lw["wo"]
+    return out, new_cache
+
+
+def mlp_block(lw: Params, x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
+    act = _activation(cfg.activation)
+    if cfg.gated_mlp:
+        h = act(x @ lw["w_gate"]) * (x @ lw["w_up"])
+    else:
+        h = act(x @ lw["w_up"])
+    return h @ lw["w_down"]
+
+
+def decoder_layer(
+    lw: Params,
+    x: jnp.ndarray,
+    cfg: TransformerConfig,
+    positions: jnp.ndarray,
+    attn_fn: Callable,
+    segment_ids: Optional[jnp.ndarray] = None,
+    cache: Optional[Tuple] = None,
+    cache_index: Optional[jnp.ndarray] = None,
+):
+    """Pre-norm residual block. Returns (x, new_cache, aux_loss)."""
+    dtype = x.dtype
+    h, new_cache = attention_block(
+        lw["attn"], norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps), cfg,
+        positions, attn_fn, segment_ids, cache, cache_index,
+    )
+    x = shard_activation(x + h.astype(dtype), ACT_SPEC)
+    aux = jnp.asarray(0.0, jnp.float32)
+    y = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.moe_num_experts > 0:
+        from ..moe.layer import moe_block
+
+        h, aux = moe_block(lw["moe"], y, cfg)
+    else:
+        h = mlp_block(lw["mlp"], y, cfg)
+    x = shard_activation(x + h.astype(dtype), ACT_SPEC)
+    return x, new_cache, aux
+
+
+# ---------------------------------------------------------------------------
+# full model
+# ---------------------------------------------------------------------------
+def _get_attn_fn(cfg: TransformerConfig) -> Callable:
+    from ..ops.attention import get_attention_impl
+
+    base = get_attention_impl(cfg.attn_impl)
+    if cfg.sequence_parallel == "ulysses":
+        from ..sequence.layer import DistributedAttention
+
+        return DistributedAttention(base)
+    if cfg.sequence_parallel == "ring":
+        from ..sequence.ring import ring_attention
+
+        return ring_attention
+    return base
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    cache: Optional[Params] = None,
+    cache_index: Optional[jnp.ndarray] = None,
+    layer_filter=None,
+) -> Tuple[jnp.ndarray, Optional[Params], jnp.ndarray]:
+    """tokens [b, s] -> (logits [b, s, v] | hidden, new_cache, moe_aux_loss).
+
+    The L layers run as one ``lax.scan`` over the stacked layer params; the
+    scanned body is optionally wrapped in ``jax.checkpoint`` per ``cfg.remat``.
+    """
+    attn_fn = _get_attn_fn(cfg)
+    b, s = tokens.shape
+    if positions is None:
+        base = cache_index if cache_index is not None else 0
+        positions = jnp.arange(s)[None, :] + base
+        positions = jnp.broadcast_to(positions, (b, s))
+    x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
+    if cfg.position == "learned":
+        x = x + params["pos_embed"]["embedding"][positions].astype(cfg.dtype)
+    x = shard_activation(x, ACT_SPEC)
+
+    def body(carry, scanned):
+        h = carry
+        lw, layer_cache = scanned
+        h, new_cache, aux = decoder_layer(
+            lw, h, cfg, positions, attn_fn, segment_ids, layer_cache, cache_index
+        )
+        return h, (new_cache, aux)
+
+    if cfg.remat == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            prevent_cse=False,
+        )
+
+    layer_params = params["layers"]
+    x, (new_caches, aux_losses) = jax.lax.scan(body, x, (layer_params, cache))
+    aux_loss = jnp.sum(aux_losses)
+
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["embedding"].T.astype(cfg.dtype)
+    else:
+        logits = x @ params["lm_head"]["kernel"]
+    return logits, new_caches, aux_loss
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None) -> Tuple:
+    """Stacked KV cache for autoregressive decode: ([L,b,S,hkv,hd], same)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.hd)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int = -100
+) -> jnp.ndarray:
+    """Token-mean causal-LM loss in fp32; positions == ignore_index masked."""
+    mask = (labels != ignore_index).astype(jnp.float32)
+    safe = jnp.where(labels == ignore_index, 0, labels)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class CausalLM:
+    """Model adapter consumed by ``deepspeed_tpu.initialize(model=...)``.
+
+    Exposes ``loss_fn(params, batch, rng)``, ``init_params(rng)``,
+    ``tp_rules`` — the contract in deepspeed_tpu/__init__.py.
+    Batch: {'input_ids': [b, s]} (labels = shifted inputs) or
+    {'input_ids', 'labels'} for pre-shifted data.
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng) -> Params:
+        return init_params(rng, self.cfg)
+
+    def apply(self, params, tokens, **kw):
+        return forward(params, tokens, self.cfg, **kw)
+
+    def loss_fn(self, params, batch, rng=None):
+        tokens = batch["input_ids"]
+        segment_ids = batch.get("segment_ids")
+        if "labels" in batch:
+            inputs, labels = tokens, batch["labels"]
+        else:
+            inputs, labels = tokens[:, :-1], tokens[:, 1:]
+            if segment_ids is not None:
+                segment_ids = segment_ids[:, :-1]
+        logits, _, aux = forward(params, inputs, self.cfg, segment_ids=segment_ids)
+        loss = cross_entropy_loss(logits, labels)
+        if self.cfg.moe_num_experts > 0:
+            loss = loss + self.cfg.moe_aux_loss_coef * aux / max(self.cfg.num_layers, 1)
+        return loss
+
+    @property
+    def tp_rules(self):
+        return tp_rules(self.cfg)
+
+    @property
+    def param_count(self) -> int:
+        return self.cfg.param_count
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (6N + attention quadratic term)."""
+        c = self.cfg
+        n = c.param_count
+        attn = 12 * c.num_layers * c.hidden_size * seq_len
+        return 6.0 * n + attn
+
+
+def tp_rules(cfg: TransformerConfig):
+    """Megatron-style tensor-parallel rules over the stacked param tree.
+
+    Column-parallel (output dim on ``model``): wq/wk/wv, w_gate/w_up.
+    Row-parallel (input dim on ``model``): wo, w_down.  Embedding and head
+    shard the vocab dim.  The leading dim of layer weights is the layer dim
+    (scanned), never sharded.  Replaces AutoTP (module_inject/auto_tp.py:193).
+    """
+    moe = cfg.moe_num_experts > 0
+    rules = [
+        (r"layers/attn/w[qkv]$", P(None, None, MODEL_AXIS)),
+        (r"layers/attn/b[qkv]$", P(None, MODEL_AXIS)),
+        (r"layers/attn/wo$", P(None, MODEL_AXIS, None)),
+        (r"embed/embedding$", P(MODEL_AXIS, None)),
+        (r"lm_head/kernel$", P(None, MODEL_AXIS)),
+    ]
+    if moe:
+        rules += [
+            (r"layers/moe/w_(gate|up)$", P(None, "expert", None, MODEL_AXIS)),
+            (r"layers/moe/w_down$", P(None, "expert", MODEL_AXIS, None)),
+        ]
+    else:
+        rules += [
+            (r"layers/mlp/w_(gate|up)$", P(None, None, MODEL_AXIS)),
+            (r"layers/mlp/w_down$", P(None, MODEL_AXIS, None)),
+        ]
+    return rules

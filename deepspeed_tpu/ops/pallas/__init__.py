@@ -1,0 +1,16 @@
+"""Pallas TPU kernels — the ``csrc/`` of this framework.
+
+Each kernel module follows the reference's op-builder contract
+(op_builder/builder.py:117 OpBuilder): an ``is_compatible()`` predicate that
+gates usage (here: TPU platform present) and a functional entry point with a
+pure-jnp fallback, so every caller works on CPU test meshes.
+"""
+
+
+def on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

@@ -1,0 +1,55 @@
+"""Blockwise flash attention (Pallas TPU).
+
+TPU-native replacement for the reference's attention kernels
+(``csrc/transformer/`` training softmax kernels, inference
+``csrc/transformer/inference/csrc/softmax.cu``, and the blocked flash
+attention in ``inference/v2/kernels/ragged_ops``).  Online-softmax blockwise
+attention computed in VMEM tiles feeding the MXU.
+
+Entry point ``flash_attention`` has the same signature as
+``ops.attention.dot_product_attention`` and falls back to it off-TPU, so the
+model code is kernel-agnostic.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..attention import dot_product_attention
+from . import on_tpu
+
+
+def is_compatible() -> bool:
+    return on_tpu()
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset=0,
+    segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+):
+    """[b, s, h, d] flash attention; currently delegates to the fused-by-XLA
+    reference body until the hand-tiled kernel (in progress) lands; the
+    pallas kernel is only selected when it beats XLA's fusion on the bench.
+    """
+    if not is_compatible():
+        return dot_product_attention(
+            q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,
+            kv_segment_ids=kv_segment_ids, scale=scale, logits_soft_cap=logits_soft_cap,
+        )
+    from .flash_kernel import pallas_flash_attention, supports
+
+    if supports(q, k, v, causal, q_offset, segment_ids, logits_soft_cap):
+        return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+    return dot_product_attention(
+        q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,
+        kv_segment_ids=kv_segment_ids, scale=scale, logits_soft_cap=logits_soft_cap,
+    )

@@ -1,0 +1,316 @@
+"""Hand-tiled blockwise (flash) attention kernels for TPU.
+
+Online-softmax attention computed in VMEM tiles feeding the MXU, with a
+custom VJP whose backward pass recomputes probabilities from the saved
+log-sum-exp (the standard flash-attention-2 decomposition):
+
+  fwd:  per (batch, head, q-block): stream kv-blocks, carry (m, l, acc)
+  bwd:  dq kernel streams kv-blocks per q-block;
+        dkv kernel streams q-blocks per kv-block;
+        p is rebuilt as exp(s - lse), ds = p * (dp - D), D = rowsum(dO * O).
+
+GQA-aware in the forward: kv heads are never materialised ``n_rep`` times —
+the BlockSpec index map routes q-head h to kv-head h // n_rep, saving HBM
+bandwidth (the reference's GQA handling instead reshapes tensors:
+sequence/layer.py:111).  Layout inside kernels is [heads*batch, seq, d].
+
+Replaces the reference's CUDA attention kernels (csrc/transformer/*,
+inference v2 blocked flash attention in inference/v2/kernels/ragged_ops).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# interpret mode lets the kernels run on the CPU test mesh (tests/conftest.py)
+_INTERPRET = False
+
+
+def set_interpret(value: bool) -> None:
+    global _INTERPRET
+    _INTERPRET = bool(value)
+
+
+def _pick_block(s: int, preferred=(512, 256, 128)) -> Optional[int]:
+    for b in preferred:
+        if s % b == 0:
+            return b
+    return None
+
+
+def supports(q, k, v, causal, q_offset, segment_ids, logits_soft_cap) -> bool:
+    """Static applicability check; callers fall back to the jnp body."""
+    if not causal or segment_ids is not None or logits_soft_cap is not None:
+        return False
+    if not isinstance(q_offset, int) or q_offset != 0:
+        return False
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    if sq != sk or sq < 128:
+        return False
+    if d not in (64, 128, 256):
+        return False
+    if hq % hk != 0:
+        return False
+    return _pick_block(sq) is not None
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, scale, bq, bk):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # skip fully-masked kv blocks (strictly above the diagonal)
+    @pl.when(ik * bk <= iq * bq + (bq - 1))
+    def _():
+        qb = q_ref[0]  # [bq, d]
+        kb = k_ref[0]  # [bk, d]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_s[:]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_s[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_s[:] = acc_s[:] * alpha + pv
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _():
+        l = l_s[:]
+        o_ref[0] = (acc_s[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = m_s[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fwd(q, k, v, scale):
+    """q [bh, s, d] (head-major flattened), k/v [bh_kv, s, d]."""
+    bh, s, d = q.shape
+    bh_kv = k.shape[0]
+    n_rep = bh // bh_kv
+    bq = _pick_block(s)
+    bk = _pick_block(s)
+    grid = (bh, s // bq, s // bk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h // n_rep, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda h, i, j: (h // n_rep, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *, scale, bq, bk):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    @pl.when(ik * bk <= iq * bq + (bq - 1))
+    def _():
+        qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])  # [bq, bk] (lse block is [bq, 1])
+        dp = jax.lax.dot_general(
+            do_ref[0], vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_s[:] += jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_s, dv_s, *, scale, bq, bk):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    @pl.when(iq * bq + (bq - 1) >= ik * bk)
+    def _():
+        qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        dob = do_ref[0]
+        dv_s[:] += jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_s[:] += jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, res, do):
+    q, k_rep, v_rep, out, lse = res  # kv already repeated to hq heads here
+    bh, s, d = q.shape
+    bq = _pick_block(s)
+    bk = _pick_block(s)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [bh, s, 1]
+
+    qspec = pl.BlockSpec((1, bq, d), lambda h, i, j: (h, i, 0))
+    kspec_q = pl.BlockSpec((1, bk, d), lambda h, i, j: (h, j, 0))
+    lspec = pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk),
+        grid=(bh, s // bq, s // bk),
+        in_specs=[qspec, kspec_q, kspec_q, qspec, lspec, lspec],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_INTERPRET,
+    )(q, k_rep, v_rep, do, lse, delta)[0]
+
+    # dkv: grid over kv blocks outer, q blocks inner
+    kspec = pl.BlockSpec((1, bk, d), lambda h, i, j: (h, i, 0))
+    qspec2 = pl.BlockSpec((1, bq, d), lambda h, i, j: (h, j, 0))
+    lspec2 = pl.BlockSpec((1, bq, 1), lambda h, i, j: (h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk),
+        grid=(bh, s // bk, s // bq),
+        in_specs=[qspec2, kspec, kspec, qspec2, lspec2, lspec2],
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k_rep.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v_rep.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(q, k_rep, v_rep, do, lse, delta)
+    return dq, dk, dv
+
+
+def _repeat_heads(x, n_rep):
+    """[bh_kv, s, d] -> [bh_kv * n_rep, s, d] with groups adjacent.
+
+    Head-major flattening puts a batch's heads contiguously, so index
+    ``b*hq + g*n_rep + r == (b*hkv + g)*n_rep + r`` — groups fold with a
+    plain reshape, no batch size needed.
+    """
+    if n_rep == 1:
+        return x
+    bhk, s, d = x.shape
+    return jnp.broadcast_to(x[:, None], (bhk, n_rep, s, d)).reshape(bhk * n_rep, s, d)
+
+
+def _reduce_heads(dx, n_rep):
+    """Transpose of _repeat_heads: sum GQA query-head groups."""
+    if n_rep == 1:
+        return dx
+    bh, s, d = dx.shape
+    return dx.reshape(bh // n_rep, n_rep, s, d).sum(axis=1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, scale):
+    out, _ = _fwd(q, k, v, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, scale):
+    out, lse = _fwd(q, k, v, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, res, do):
+    q, k, v, out, lse = res
+    n_rep = q.shape[0] // k.shape[0]
+    res_rep = (q, _repeat_heads(k, n_rep), _repeat_heads(v, n_rep), out, lse)
+    dq, dk_rep, dv_rep = _bwd(scale, res_rep, do)
+    return dq, _reduce_heads(dk_rep, n_rep), _reduce_heads(dv_rep, n_rep)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def pallas_flash_attention(q, k, v, causal=True, scale=None):
+    """[b, s, h, d] API wrapper: transpose to head-major, run the kernels.
+    GQA kv-head routing happens inside (forward: BlockSpec index map;
+    backward: repeated view + group-sum)."""
+    b, s, hq, d = q.shape
+    scale = float(scale) if scale is not None else float(d) ** -0.5
+
+    def to_hm(x):
+        xb, xs, xh, xd = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(xb * xh, xs, xd)
+
+    def from_hm(x, h):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    out = _flash(to_hm(q), to_hm(k), to_hm(v), scale)
+    return from_hm(out, hq)
