@@ -73,6 +73,8 @@ class TransformerConfig:
     attn_impl: str = "reference"  # 'reference' | 'flash' | 'auto'
     # sequence parallelism: 'none' | 'ulysses' | 'ring'
     sequence_parallel: str = "none"
+    # chunked logits+loss (FPDT_LogitsLoss analogue): 0 = full logits
+    loss_chunk_size: int = 0
 
     @property
     def hd(self) -> int:
@@ -94,40 +96,9 @@ class TransformerConfig:
         return L * per_layer + emb + d
 
 
-# ---------------------------------------------------------------------------
-# activation-sharding hints (GSPMD): a lightweight "current mesh" context so
-# models can constrain activations without threading the mesh through every
-# call.  No mesh set -> constraints are no-ops (single-device tests).
-# ---------------------------------------------------------------------------
-_CURRENT_MESH = None
-
-
-def set_current_mesh(mesh) -> None:
-    global _CURRENT_MESH
-    _CURRENT_MESH = mesh
-
-
-def shard_activation(x: jnp.ndarray, spec: P) -> jnp.ndarray:
-    if _CURRENT_MESH is None:
-        return x
-    from jax.sharding import NamedSharding
-
-    # drop axis entries that don't divide the dimension (tiny test shapes);
-    # real meshes keep the full spec and constraint errors surface loudly
-    sizes = dict(zip(_CURRENT_MESH.axis_names, _CURRENT_MESH.devices.shape))
-
-    def ok(dim, entry):
-        axes = entry if isinstance(entry, tuple) else (entry,)
-        import math
-
-        return dim % math.prod(sizes.get(a, 1) for a in axes) == 0
-
-    entries = tuple(
-        e if (e is None or ok(d, e)) else None for d, e in zip(x.shape, tuple(spec))
-    )
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(_CURRENT_MESH, P(*entries))
-    )
+# activation-sharding hints (GSPMD) — ambient mesh context lives in
+# parallel/sharding.py; re-exported here for the public API.
+from ..parallel.sharding import set_current_mesh, shard_activation  # noqa: E402
 
 
 ACT_SPEC = P((DATA_AXIS, FSDP_AXIS), SEQ_AXIS, None)  # [batch, seq, hidden]
@@ -343,7 +314,7 @@ def forward(
     segment_ids: Optional[jnp.ndarray] = None,
     cache: Optional[Params] = None,
     cache_index: Optional[jnp.ndarray] = None,
-    layer_filter=None,
+    return_hidden: bool = False,
 ) -> Tuple[jnp.ndarray, Optional[Params], jnp.ndarray]:
     """tokens [b, s] -> (logits [b, s, v] | hidden, new_cache, moe_aux_loss).
 
@@ -383,11 +354,17 @@ def forward(
     aux_loss = jnp.sum(aux_losses)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    if cfg.tie_embeddings:
-        logits = x @ params["embed"]["embedding"].T.astype(cfg.dtype)
-    else:
-        logits = x @ params["lm_head"]["kernel"]
+    if return_hidden:
+        return x, new_caches, aux_loss
+    logits = x @ head_kernel(params, cfg)
     return logits, new_caches, aux_loss
+
+
+def head_kernel(params: Params, cfg: TransformerConfig) -> jnp.ndarray:
+    """[d, v] output projection (transposed embedding when tied)."""
+    if cfg.tie_embeddings:
+        return params["embed"]["embedding"].T.astype(cfg.dtype)
+    return params["lm_head"]["kernel"]
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None) -> Tuple:
@@ -437,8 +414,19 @@ class CausalLM:
             inputs, labels = tokens[:, :-1], tokens[:, 1:]
             if segment_ids is not None:
                 segment_ids = segment_ids[:, :-1]
-        logits, _, aux = forward(params, inputs, self.cfg, segment_ids=segment_ids)
-        loss = cross_entropy_loss(logits, labels)
+        if self.cfg.loss_chunk_size:
+            from ..sequence.cross_entropy import chunked_cross_entropy
+
+            hidden, _, aux = forward(
+                params, inputs, self.cfg, segment_ids=segment_ids, return_hidden=True
+            )
+            loss = chunked_cross_entropy(
+                hidden, head_kernel(params, self.cfg), labels,
+                chunk_size=self.cfg.loss_chunk_size,
+            )
+        else:
+            logits, _, aux = forward(params, inputs, self.cfg, segment_ids=segment_ids)
+            loss = cross_entropy_loss(logits, labels)
         if self.cfg.moe_num_experts > 0:
             loss = loss + self.cfg.moe_aux_loss_coef * aux / max(self.cfg.num_layers, 1)
         return loss

@@ -1,0 +1,60 @@
+"""Mesh context + activation-sharding helpers (GSPMD side).
+
+The reference threads process-group handles through every module
+(deepspeed/utils/groups.py getters).  Here the analogue is one ambient mesh:
+``set_current_mesh`` installs it, ``shard_activation`` applies a
+``PartitionSpec`` constraint against it inside jit.  Constraints drop axis
+entries that don't divide the dimension (tiny test shapes) instead of
+failing, but keep full specs on real shapes so layout errors surface.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+_CURRENT_MESH = None
+
+
+def set_current_mesh(mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_current_mesh():
+    return _CURRENT_MESH
+
+
+def axis_size(name: str) -> int:
+    """Size of a mesh axis in the ambient mesh (1 if absent / no mesh)."""
+    if _CURRENT_MESH is None:
+        return 1
+    sizes = dict(zip(_CURRENT_MESH.axis_names, _CURRENT_MESH.devices.shape))
+    return sizes.get(name, 1)
+
+
+def filter_spec(shape, spec: P, mesh=None) -> P:
+    """Drop spec entries whose mesh-axis product doesn't divide the dim —
+    keeps tiny test shapes working while real shapes get the full spec."""
+    mesh = mesh if mesh is not None else _CURRENT_MESH
+    if mesh is None:
+        return P(*([None] * len(shape)))
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def ok(dim, entry):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        return dim % math.prod(sizes.get(a, 1) for a in axes) == 0
+
+    return P(*(
+        e if (e is None or ok(d, e)) else None for d, e in zip(shape, tuple(spec))
+    ))
+
+
+def shard_activation(x: jax.Array, spec: P) -> jax.Array:
+    if _CURRENT_MESH is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(_CURRENT_MESH, filter_spec(x.shape, spec))
+    )

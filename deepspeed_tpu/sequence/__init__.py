@@ -1,0 +1,13 @@
+"""Sequence parallelism: Ulysses head-scatter + ring attention + SP loss.
+
+TPU-native counterpart of ``deepspeed/sequence/`` (DistributedAttention
+``layer.py:311``, FPDT ``fpdt_layer.py``, SP cross entropy
+``cross_entropy.py``), plus ring attention — the long-context mechanism the
+reference lacks (SURVEY §5.7) but which is idiomatic on the ICI torus.
+"""
+from .layer import DistributedAttention, ulysses_spec  # noqa: F401
+from .ring import ring_attention  # noqa: F401
+from .cross_entropy import (  # noqa: F401
+    chunked_cross_entropy,
+    vocab_parallel_cross_entropy,
+)

@@ -1,0 +1,66 @@
+"""Ulysses sequence parallelism, the GSPMD way.
+
+The reference's ``DistributedAttention`` (sequence/layer.py:311) wraps a
+local attention with two explicit all-to-alls: scatter heads / gather
+sequence before ([b, s/P, h, d] -> [b, s, h/P, d], ``single_all_to_all``
+layer.py:221), and the reverse after.  On TPU the same data movement is a
+*sharding change*: constraining q/k/v from sequence-sharded to head-sharded
+makes XLA emit exactly that all-to-all over the ICI ring, fused into its
+latency-hiding schedule — no handle juggling, composes with GQA (the kv head
+dim may be smaller than the seq axis; the spec filter then falls back to
+replicating kv heads, the same degenerate case the reference handles with
+``uneven_heads_all2all`` layer.py:111).
+
+An explicit ``shard_map`` variant (``single_all_to_all``) is also provided
+for the manual-collective path (pipeline engine interop, tests).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import shard_activation
+from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+BATCH = (DATA_AXIS, FSDP_AXIS)
+
+
+def ulysses_spec(phase: str) -> P:
+    """PartitionSpecs for the two layouts of [b, s, h, d] tensors.
+
+    'sequence': sharded on s (the resting layout of all activations)
+    'head':     sharded on h (the layout attention math runs in)
+    TP ('model') stays on the head dim in both phases.
+    """
+    if phase == "sequence":
+        return P(BATCH, SEQ_AXIS, MODEL_AXIS, None)
+    return P(BATCH, None, (MODEL_AXIS, SEQ_AXIS), None)
+
+
+class DistributedAttention:
+    """Callable with the ops.attention signature; wraps any local attention.
+
+    reference: sequence/layer.py:311 — same role, zero lines of comm code.
+    """
+
+    def __init__(self, local_attention: Callable):
+        self.local_attention = local_attention
+
+    def __call__(self, q, k, v, **kw):
+        q = shard_activation(q, ulysses_spec("head"))
+        k = shard_activation(k, ulysses_spec("head"))
+        v = shard_activation(v, ulysses_spec("head"))
+        out = self.local_attention(q, k, v, **kw)
+        return shard_activation(out, ulysses_spec("sequence"))
+
+
+def single_all_to_all(x: jnp.ndarray, scatter_idx: int, gather_idx: int, axis_name: str):
+    """Explicit all-to-all for the shard_map path (reference
+    sequence/layer.py:221).  x is the *local* shard; scatter_idx's dimension
+    is split across the axis, gather_idx's is concatenated."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True
+    )

@@ -1,0 +1,133 @@
+"""Ring attention over the ICI torus — the long-context flagship.
+
+The reference's long-context path is Ulysses + FPDT chunking
+(sequence/fpdt_layer.py, online softmax ``update_out_and_lse`` :58); it has
+no ring/context-parallel attention (SURVEY §5.7).  On TPU the ring is the
+natural mechanism: KV blocks rotate around the ``seq`` mesh axis via
+``lax.ppermute`` (nearest-neighbour ICI hops) while each device accumulates
+online-softmax partial results for its resident queries — comm volume
+O(s/P) per step, fully overlappable with the blockwise attention compute.
+
+Implemented as a ``shard_map`` region differentiable by JAX autodiff (the
+ppermute transposes to the reverse rotation); the scanned step is
+checkpointed so backward recomputes per-chunk attention instead of storing
+all P chunk probability matrices.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention, repeat_kv
+from ..parallel.sharding import axis_size, filter_spec, get_current_mesh
+from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+
+BATCH = (DATA_AXIS, FSDP_AXIS)
+NEG_INF = -1e30
+
+
+def _ring_local(ql, kl, vl, *, axis_name: str, n_steps: int, scale: float):
+    """Per-device body: ql [b, sq, h, d] resident; kv chunks rotate.
+
+    Online softmax accumulation in fp32 ([b, h, sq] running max / denom).
+    """
+    b, sq, h, d = ql.shape
+    n_rep = h // kl.shape[2]
+    my = lax.axis_index(axis_name)
+    qf = ql.astype(jnp.float32)
+
+    def attend(kc, vc, src):
+        kcr = repeat_kv(kc, n_rep)
+        vcr = repeat_kv(vc, n_rep)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kcr.astype(jnp.float32)) * scale
+        q_pos = my * sq + lax.broadcasted_iota(jnp.int32, (sq, kc.shape[1]), 0)
+        k_pos = src * sq + lax.broadcasted_iota(jnp.int32, (sq, kc.shape[1]), 1)
+        s = jnp.where(q_pos[None, None] >= k_pos[None, None], s, NEG_INF)
+        return s, vcr
+
+    perm = [(i, (i + 1) % n_steps) for i in range(n_steps)]
+
+    def update(m, l, acc, kc, vc, t):
+        src = (my - t) % n_steps  # rank whose kv chunk we currently hold
+        s, vcr = attend(kc, vc, src)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vcr.astype(jnp.float32)
+        )
+        return m_new, l, acc
+
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        m, l, acc = update(m, l, acc, kc, vc, t)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    # n_steps - 1 rotations; the final resident chunk attends without the
+    # (discarded) last ppermute
+    (m, l, acc, kc, vc), _ = lax.scan(
+        step, (m0, l0, acc0, kl, vl), jnp.arange(n_steps - 1)
+    )
+    m, l, acc = update(m, l, acc, kc, vc, n_steps - 1)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(ql.dtype)  # [b, sq, h, d]
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset=0,
+    segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+):
+    """Drop-in attention body; [b, s, h, d] global-view arrays sharded on the
+    ``seq`` axis.  Falls back to the reference body when unsupported
+    (non-causal, decode, segments) or when no seq axis is present."""
+    mesh = get_current_mesh()
+    sp = axis_size(SEQ_AXIS)
+    unsupported = (
+        not causal
+        or segment_ids is not None
+        or logits_soft_cap is not None
+        or not (isinstance(q_offset, int) and q_offset == 0)
+    )
+    if (
+        mesh is None or sp == 1 or unsupported
+        or q.shape[1] != k.shape[1] or q.shape[1] % sp
+    ):
+        return dot_product_attention(
+            q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,
+            kv_segment_ids=kv_segment_ids, scale=scale, logits_soft_cap=logits_soft_cap,
+        )
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else float(d) ** -0.5
+    # head dims may be sharded by TP ('model'); entries that don't divide
+    # (tiny batch, few kv heads) are dropped per-array
+    q_spec = filter_spec(q.shape, P(BATCH, SEQ_AXIS, MODEL_AXIS, None))
+    kv_spec = filter_spec(k.shape, P(BATCH, SEQ_AXIS, MODEL_AXIS, None))
+    if q_spec[2] != kv_spec[2]:
+        # q heads TP-sharded but kv heads not divisible: replicate q heads too
+        q_spec = P(q_spec[0], q_spec[1], None, None)
+
+    body = functools.partial(_ring_local, axis_name=SEQ_AXIS, n_steps=sp, scale=scale)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,128 @@
+"""Sequence parallelism: ring attention / Ulysses / SP-loss parity tests
+(reference pattern: tests/unit/sequence_parallelism/test_ulysses.py)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import CausalLM, get_preset
+from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.parallel.sharding import set_current_mesh
+from deepspeed_tpu.parallel.topology import initialize_mesh
+from deepspeed_tpu.sequence import (
+    DistributedAttention,
+    chunked_cross_entropy,
+    ring_attention,
+    vocab_parallel_cross_entropy,
+)
+from deepspeed_tpu.models.transformer import cross_entropy_loss
+
+
+@pytest.fixture
+def seq_mesh():
+    grid = initialize_mesh(data=2, seq=4)
+    set_current_mesh(grid.mesh)
+    yield grid
+    set_current_mesh(None)
+
+
+def _qkv(b, s, hq, hkv, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(b, s, hq, d)) * 0.5, jnp.float32),
+        jnp.asarray(rng.normal(size=(b, s, hkv, d)) * 0.5, jnp.float32),
+        jnp.asarray(rng.normal(size=(b, s, hkv, d)) * 0.5, jnp.float32),
+    )
+
+
+def test_ring_attention_matches_reference(seq_mesh):
+    q, k, v = _qkv(2, 64, 4, 2, 16)
+    out = jax.jit(ring_attention)(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grads_match(seq_mesh):
+    q, k, v = _qkv(1, 32, 2, 2, 8, seed=3)
+
+    g_ring = jax.jit(jax.grad(lambda q, k, v: jnp.sum(ring_attention(q, k, v) ** 2), argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_ring_fallback_without_mesh():
+    set_current_mesh(None)
+    q, k, v = _qkv(1, 16, 2, 2, 8)
+    out = ring_attention(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_ulysses_matches_reference(seq_mesh):
+    q, k, v = _qkv(2, 64, 8, 4, 16, seed=1)
+    dist = DistributedAttention(dot_product_attention)
+    out = jax.jit(lambda q, k, v: dist(q, k, v, causal=True))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_model_with_ring_matches_dense(seq_mesh):
+    cfg = get_preset("tiny", max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 64, (2, 33)))}
+    base = float(jax.jit(model.loss_fn)(params, batch))
+    ring_model = CausalLM(cfg.replace(sequence_parallel="ring"))
+    ringl = float(jax.jit(ring_model.loss_fn)(params, batch))
+    assert abs(base - ringl) < 2e-3, (base, ringl)
+
+
+def test_vocab_parallel_cross_entropy(seq_mesh):
+    rng = np.random.default_rng(0)
+    b, s, v_total = 2, 8, 32
+    logits = jnp.asarray(rng.normal(size=(b, s, v_total)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v_total, (b, s)))
+    labels = labels.at[0, 0].set(-100)  # exercise ignore_index
+
+    mesh = seq_mesh.mesh
+
+    def local(logits_shard, labels_rep):
+        idx = jax.lax.axis_index("seq")
+        offset = idx * (v_total // 4)
+        return vocab_parallel_cross_entropy(logits_shard, labels_rep, "seq", offset)
+
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=(P(None, None, "seq"), P(None, None)),
+        out_specs=P(), check_vma=False,
+    )
+    got = float(fn(logits, labels))
+    ref = float(cross_entropy_loss(logits, labels))
+    assert abs(got - ref) < 1e-5, (got, ref)
+
+
+def test_chunked_cross_entropy_matches_full():
+    rng = np.random.default_rng(0)
+    b, s, d, v = 2, 32, 16, 64
+    hidden = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+    kernel = jnp.asarray(rng.normal(size=(d, v)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, (b, s)))
+    labels = labels.at[1, 3].set(-100)
+    full = cross_entropy_loss(hidden @ kernel, labels)
+    chunked = chunked_cross_entropy(hidden, kernel, labels, chunk_size=8)
+    assert abs(float(full) - float(chunked)) < 1e-5
+
+
+def test_chunked_loss_in_model():
+    cfg = get_preset("tiny")
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 64, (2, 33)))}
+    base = float(model.loss_fn(params, batch))
+    chunked = float(CausalLM(cfg.replace(loss_chunk_size=8)).loss_fn(params, batch))
+    assert abs(base - chunked) < 1e-3
