@@ -1,0 +1,7 @@
+"""Mixture-of-Experts: gating + expert-parallel dispatch.
+
+TPU-native counterpart of ``deepspeed/moe/`` (MoE ``layer.py:17``, Experts
+``experts.py:13``, MOELayer + gating ``sharded_moe.py:183-533``).
+"""
+from .layer import MoE, moe_block, routed_ffn  # noqa: F401
+from .sharded_moe import top1_gating, topk_gating  # noqa: F401

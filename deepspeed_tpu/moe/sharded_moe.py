@@ -1,0 +1,122 @@
+"""Gating math: top-1 / top-2 / top-k routing with capacity and aux loss.
+
+Ports the *semantics* of the reference's gating functions
+(``moe/sharded_moe.py:183 top1gating``, ``:290 top2gating``, ``:374
+topkgating``): softmax router, per-expert capacity
+``ceil(k * tokens / experts * capacity_factor)`` with a ``min_capacity``
+floor, position-in-expert computed by masked cumulative sum, tokens beyond
+capacity dropped, load-balancing aux loss ``E * Σ_e me·ce`` (GShard eq.),
+optional random token priority (rts) and top-2 weight renormalisation.
+
+Everything is static-shape dense math — [tokens, experts, capacity] one-hot
+dispatch/combine tensors contracted on the MXU, the canonical TPU MoE
+formulation — rather than the reference's index-based scatter.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GatingResult(NamedTuple):
+    combine: jnp.ndarray  # [N, E, C] fp32 — combine weights
+    dispatch: jnp.ndarray  # [N, E, C] bool — dispatch mask
+    aux_loss: jnp.ndarray  # scalar load-balance loss
+    # diagnostics (reference logs these via its gate metrics)
+    expert_counts: jnp.ndarray  # [E] tokens routed (pre-drop)
+    dropped_fraction: jnp.ndarray  # scalar
+
+
+def capacity_for(num_tokens: int, num_experts: int, k: int,
+                 capacity_factor: float, min_capacity: int = 4) -> int:
+    """reference: sharded_moe.py _capacity."""
+    cap = int(num_tokens * k * capacity_factor / num_experts + 0.9999)
+    return max(cap, min_capacity)
+
+
+def _position_in_expert(mask: jnp.ndarray) -> jnp.ndarray:
+    """mask [N, E] 0/1 -> position of each token within its expert's queue
+    (exclusive cumsum over the token dimension)."""
+    return jnp.cumsum(mask, axis=0) - mask
+
+
+def topk_gating(
+    logits: jnp.ndarray,
+    k: int,
+    capacity_factor: float = 1.25,
+    min_capacity: int = 4,
+    normalize_weights: bool = True,
+    rng: Optional[jax.Array] = None,
+    random_token_priority: bool = False,
+) -> GatingResult:
+    """logits [N, E] -> GatingResult with capacity-bounded top-k routing.
+
+    ``random_token_priority`` shuffles the token order used for the capacity
+    cumsum (reference: RTP in top1gating), removing the bias toward early
+    sequence positions when tokens are dropped.
+    """
+    n, e = logits.shape
+    cap = capacity_for(n, e, k, capacity_factor, min_capacity)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # token order used for capacity assignment
+    if random_token_priority and rng is not None:
+        order = jax.random.permutation(rng, n)
+    else:
+        order = jnp.arange(n)
+    inv_order = jnp.argsort(order)
+
+    topv, topi = jax.lax.top_k(probs, k)  # [N, k]
+
+    slots = []
+    keeps = []
+    # occupancy accumulates across the k choices so a token's 2nd choice
+    # queues behind all 1st choices (reference: top2gating's locations2
+    # offset by locations1 count)
+    occupancy = jnp.zeros((e,), jnp.int32)
+    for choice in range(k):
+        mask = jax.nn.one_hot(topi[:, choice], e, dtype=jnp.int32)  # [N, E]
+        mask_p = mask[order]  # priority order
+        pos_p = _position_in_expert(mask_p) + occupancy[None, :]
+        pos = pos_p[inv_order]
+        within = (pos < cap) & (mask > 0)
+        loc = jnp.sum(jnp.where(within, pos, 0), axis=1)  # [N]
+        keep = jnp.any(within, axis=1)
+        oh_cap = jax.nn.one_hot(loc, cap, dtype=jnp.float32) * keep[:, None]
+        oh_exp = jax.nn.one_hot(topi[:, choice], e, dtype=jnp.float32)
+        slots.append(oh_exp[:, :, None] * oh_cap[:, None, :])  # [N, E, C]
+        keeps.append(keep)
+        occupancy = occupancy + jnp.sum(mask, axis=0)
+
+    # renormalise over the *surviving* choices (reference top2gating computes
+    # the denominator after the capacity mask), so a token whose other choice
+    # was dropped still contributes with full weight
+    kept_vals = jnp.stack(
+        [topv[:, c] * keeps[c].astype(jnp.float32) for c in range(k)], axis=1
+    )  # [N, k]
+    if normalize_weights and k > 1:
+        denom = jnp.maximum(jnp.sum(kept_vals, axis=1, keepdims=True), 1e-9)
+        weights = kept_vals / denom
+    else:
+        weights = kept_vals
+    combine = sum(slots[c] * weights[:, c][:, None, None] for c in range(k))
+    dispatch = combine > 0
+    counts = occupancy.astype(jnp.float32)
+
+    # load-balance loss on first-choice assignments (reference: top1/topk use
+    # the primary routing fractions)
+    me = jnp.mean(probs, axis=0)  # [E] mean router prob
+    first_mask = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
+    ce = jnp.mean(first_mask, axis=0)  # [E] fraction of tokens
+    aux = e * jnp.sum(me * ce)
+
+    routed = sum(jnp.sum(kp.astype(jnp.float32)) for kp in keeps)
+    dropped = 1.0 - routed / jnp.maximum(jnp.sum(counts), 1.0)
+    return GatingResult(combine, dispatch, aux, counts, dropped)
+
+
+def top1_gating(logits, capacity_factor=1.0, **kw) -> GatingResult:
+    """reference: sharded_moe.py:183 top1gating."""
+    return topk_gating(logits, 1, capacity_factor, **kw)
