@@ -46,7 +46,10 @@ def routed_ffn(
     """
     b, s, d = x.shape
     xf = x.reshape(b * s, d)
-    logits = (xf @ router_kernel).astype(jnp.float32)  # router math in fp32
+    # router math fully in fp32 (reference sharded_moe.py casts input and
+    # gate weight to float before the linear) — bf16 logits would quantize
+    # near-tied expert choices
+    logits = xf.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
     gate = topk_gating(logits, k, capacity_factor, min_capacity=min_capacity)
     xe = jnp.einsum("nec,nd->ecd", gate.dispatch.astype(x.dtype), xf)
     xe = shard_activation(xe, P(EXPERT_AXIS, BATCH, None))

@@ -62,7 +62,9 @@ def topk_gating(
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     # token order used for capacity assignment
-    if random_token_priority and rng is not None:
+    if random_token_priority:
+        if rng is None:
+            raise ValueError("random_token_priority=True requires an rng key")
         order = jax.random.permutation(rng, n)
     else:
         order = jnp.arange(n)

@@ -27,6 +27,22 @@ def get_current_mesh():
     return _CURRENT_MESH
 
 
+class mesh_disabled:
+    """Trace-time context: suppress shard_activation constraints inside —
+    used by the pipeline executor, where explicit sharding constraints in a
+    partially-manual shard_map region crash XLA's backward partitioner
+    ('Invalid binary instruction opcode copy')."""
+
+    def __enter__(self):
+        global _CURRENT_MESH
+        self._prev = _CURRENT_MESH
+        _CURRENT_MESH = None
+
+    def __exit__(self, *exc):
+        global _CURRENT_MESH
+        _CURRENT_MESH = self._prev
+
+
 def axis_size(name: str) -> int:
     """Size of a mesh axis in the ambient mesh (1 if absent / no mesh)."""
     if _CURRENT_MESH is None:

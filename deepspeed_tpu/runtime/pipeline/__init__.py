@@ -1,0 +1,19 @@
+from .module import LayerSpec, PipelineModule, partition_balanced, partition_layers  # noqa: F401
+from .pipelined import PipelinedCausalLM, pipeline_apply  # noqa: F401
+from .schedule import (  # noqa: F401
+    BackwardPass,
+    DataParallelSchedule,
+    ForwardPass,
+    InferenceSchedule,
+    LoadMicroBatch,
+    OptimizerStep,
+    PipeInstruction,
+    PipeSchedule,
+    RecvActivation,
+    RecvGrad,
+    ReduceGrads,
+    ReduceTiedGrads,
+    SendActivation,
+    SendGrad,
+    TrainSchedule,
+)

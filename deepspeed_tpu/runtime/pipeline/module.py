@@ -1,0 +1,131 @@
+"""Pipeline layer partitioning.
+
+Port of the reference's ``PipelineModule`` layer bookkeeping
+(``runtime/pipe/module.py:86``; ``LayerSpec`` :30; ``_partition_layers``
+:393 with methods ``uniform`` / ``parameters`` / ``type:regex``).  On TPU the
+partition result is consumed two ways: by the fused ``shard_map`` executor
+(equal slices of the stacked layer pytree) and by host-side tooling
+(checkpoint layout, profiling) that needs layer→stage maps for heterogeneous
+stacks.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence
+
+
+@dataclass
+class LayerSpec:
+    """Deferred layer description (reference pipe/module.py:30): a builder +
+    metadata, so partitioning can happen before parameters exist."""
+
+    build: Callable[..., Any]
+    name: str = ""
+    param_count: int = 0
+    kwargs: dict = field(default_factory=dict)
+
+    def instantiate(self):
+        return self.build(**self.kwargs)
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Split ``weights`` into ``num_parts`` contiguous chunks minimising the
+    max chunk weight (binary search over the bottleneck, greedy packing —
+    same contract as the reference's ds_utils.partition_balanced).
+    Returns part boundaries of length num_parts + 1."""
+    n = len(weights)
+    if num_parts > n:
+        raise ValueError(f"cannot split {n} layers into {num_parts} stages")
+    prefix = [0.0]
+    for w in weights:
+        prefix.append(prefix[-1] + float(w))
+
+    def parts_needed(cap: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end with weight(start, end) <= cap
+            end = start
+            while end < n and prefix[end + 1] - prefix[start] <= cap:
+                end += 1
+            if end == start:  # single layer exceeds cap
+                return None
+            bounds.append(end)
+            start = end
+            if end == n:
+                break
+        if bounds[-1] != n:
+            return None  # cap too small: couldn't cover all layers
+        # covered in fewer chunks than stages: feasible — split chunks (from
+        # the largest) until we have exactly num_parts non-empty parts
+        while len(bounds) < num_parts + 1:
+            widths = [bounds[i + 1] - bounds[i] for i in range(len(bounds) - 1)]
+            i = max(range(len(widths)), key=lambda j: widths[j])
+            if widths[i] < 2:
+                return None  # more stages than layers in every chunk
+            bounds.insert(i + 1, bounds[i] + widths[i] // 2)
+        return bounds
+
+    lo = max(weights) if weights else 0.0
+    hi = prefix[-1]
+    best = parts_needed(hi)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        got = parts_needed(mid)
+        if got is not None:
+            best, hi = got, mid
+        else:
+            lo = mid
+    if best is None:
+        # fall back to uniform boundaries
+        best = [round(i * n / num_parts) for i in range(num_parts + 1)]
+    return best
+
+
+def partition_layers(
+    specs: Sequence[LayerSpec],
+    num_stages: int,
+    method: str = "uniform",
+) -> List[int]:
+    """Layer->stage boundaries (reference pipe/module.py:393
+    ``_partition_layers``).  method: 'uniform' | 'parameters' |
+    'type:<regex>' (count only layers whose name matches)."""
+    n = len(specs)
+    if method == "uniform":
+        return partition_balanced([1.0] * n, num_stages)
+    if method == "parameters":
+        return partition_balanced([max(s.param_count, 0) or 1 for s in specs], num_stages)
+    if method.startswith("type:"):
+        pattern = method.split(":", 1)[1]
+        weights = [1.0 if re.search(pattern, s.name) else 0.0 for s in specs]
+        if sum(weights) == 0:
+            raise ValueError(f"no layer matches type regex '{pattern}'")
+        return partition_balanced(weights, num_stages)
+    raise ValueError(f"unknown partition method '{method}'")
+
+
+@dataclass
+class PipelineModule:
+    """Host-side layer/stage bookkeeping for heterogeneous layer stacks.
+
+    The homogeneous-transformer fast path doesn't need this (stacked params
+    slice evenly); it exists for parity and for models with uneven layers.
+    """
+
+    layers: List[LayerSpec]
+    num_stages: int
+    partition_method: str = "uniform"
+    bounds: List[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.bounds = partition_layers(self.layers, self.num_stages, self.partition_method)
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.bounds[s] <= layer_idx < self.bounds[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def layers_of_stage(self, stage_id: int) -> range:
+        return range(self.bounds[stage_id], self.bounds[stage_id + 1])

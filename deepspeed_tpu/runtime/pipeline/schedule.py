@@ -1,0 +1,207 @@
+"""Pipeline instruction schedules.
+
+Port of the reference's schedule abstraction (``runtime/pipe/schedule.py``:
+``PipeSchedule`` base :11, ``InferenceSchedule`` :135, ``TrainSchedule`` :189
+(1F1B), ``DataParallelSchedule`` :284, instruction classes :327-489) — kept
+because it is a good abstraction (SURVEY §7): schedules are pure-Python
+generators of instruction lists, independently unit-testable, and document
+exactly what the fused XLA executor (``pipelined.py``) must be equivalent to.
+
+On TPU the *executor* differs: the whole schedule is one jit-compiled
+``shard_map`` loop (forward) + its autodiff transpose (backward), so
+TrainSchedule's interleaving becomes XLA's problem.  These objects remain the
+source of truth for buffer counts and for the host-driven eager executor used
+in tests and debugging.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List
+
+
+# ---------------------------------------------------------------------------
+# instructions (reference: schedule.py:327-489)
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class PipeInstruction:
+    kwargs: dict = field(default_factory=dict)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in sorted(self.__dict__.items()) if k != "kwargs")
+        return f"{type(self).__name__}({args})"
+
+
+@dataclass(frozen=True, repr=False)
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+@dataclass(frozen=True, repr=False)
+class BufferOpInstruction(PipeInstruction):
+    buffer_id: int = 0
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+class PipeSchedule:
+    """Iterable of per-step instruction lists for one (micro_batches, stages,
+    stage_id) coordinate — reference schedule.py:11."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    def _valid_micro_batch(self, mb: int) -> bool:
+        return 0 <= mb < self.micro_batches
+
+    def _valid_stage(self, s: int) -> bool:
+        return 0 <= s < self.stages
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference schedule.py:135)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for step_id in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = step_id - self.stage_id
+            buf = step_id % 2
+            if self._valid_micro_batch(mb):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference schedule.py:189): steady state alternates one forward
+    with one backward; drains backwards, then reduces and steps."""
+
+    def num_pipe_buffers(self) -> int:
+        # reference: min(stages - stage_id, micro_batches)
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def _step_to_micro_batch(self, step_id: int):
+        """Even steps are forwards, odd steps backwards (reference
+        schedule.py:236-263)."""
+        if step_id % 2 == 0:
+            mb = step_id // 2 - self.stage_id
+            return mb, True
+        mb = (step_id - 1) // 2 - (self.stages - self.stage_id - 1)
+        return mb, False
+
+    def steps(self):
+        prev_mb = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            mb, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+            buf = mb % self.num_pipe_buffers() if mb >= 0 else 0
+
+            if self._valid_micro_batch(prev_mb):
+                prev_buf = prev_mb % self.num_pipe_buffers()
+                # exchange boundary data for the *previous* compute
+                if is_forward:
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(buffer_id=prev_buf))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(SendActivation(buffer_id=prev_buf))
+            if self._valid_micro_batch(mb):
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(buffer_id=buf))
+                    else:
+                        cmds.append(RecvActivation(buffer_id=buf))
+                    cmds.append(ForwardPass(buffer_id=buf))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(buffer_id=buf))
+                    cmds.append(BackwardPass(buffer_id=buf))
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            prev_mb = mb
+            yield cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference schedule.py:284)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            cmds = [LoadMicroBatch(buffer_id=0), ForwardPass(buffer_id=0),
+                    BackwardPass(buffer_id=0)]
+            if mb == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds

@@ -1,0 +1,169 @@
+"""Pipeline parallelism: schedule semantics (reference
+tests/unit/runtime/pipe/), partitioning, and fused-executor parity."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import CausalLM, get_preset
+from deepspeed_tpu.parallel.sharding import set_current_mesh
+from deepspeed_tpu.parallel.topology import initialize_mesh
+from deepspeed_tpu.runtime.pipeline import (
+    ForwardPass,
+    InferenceSchedule,
+    LayerSpec,
+    LoadMicroBatch,
+    OptimizerStep,
+    PipelinedCausalLM,
+    TrainSchedule,
+    partition_balanced,
+    partition_layers,
+    pipeline_apply,
+)
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+def _instr_types(sched):
+    return [[type(c).__name__ for c in step] for step in sched]
+
+
+def test_train_schedule_covers_all_microbatches():
+    for stages, mb in [(2, 4), (4, 4), (4, 8)]:
+        for sid in range(stages):
+            steps = list(TrainSchedule(mb, stages, sid))
+            fwd = sum(1 for s in steps for c in s if type(c).__name__ == "ForwardPass")
+            bwd = sum(1 for s in steps for c in s if type(c).__name__ == "BackwardPass")
+            assert fwd == mb and bwd == mb, (stages, sid, fwd, bwd)
+            # optimizer steps exactly once, at the end
+            opt = [i for i, s in enumerate(steps) for c in s if isinstance(c, OptimizerStep)]
+            assert opt == [len(steps) - 1]
+
+
+def test_train_schedule_first_stage_loads_batches():
+    steps = _instr_types(TrainSchedule(4, 2, 0))
+    loads = sum(s.count("LoadMicroBatch") for s in steps)
+    assert loads == 4
+    # stage 0 never receives activations
+    assert not any("RecvActivation" in s for s in steps)
+
+
+def test_inference_schedule_pipeline_fill():
+    # last stage of 2: first forward at step 1 (after fill)
+    steps = _instr_types(InferenceSchedule(3, 2, 1))
+    assert steps[0] == []
+    assert "ForwardPass" in steps[1]
+
+
+# ---------------------------------------------------------------------------
+# partitioning
+# ---------------------------------------------------------------------------
+def test_partition_balanced_uniform():
+    assert partition_balanced([1, 1, 1, 1], 2) == [0, 2, 4]
+    assert partition_balanced([1] * 8, 4) == [0, 2, 4, 6, 8]
+
+
+def test_partition_by_parameters():
+    specs = [LayerSpec(build=lambda: None, name=f"l{i}", param_count=c)
+             for i, c in enumerate([100, 1, 1, 100])]
+    bounds = partition_layers(specs, 2, "parameters")
+    # heavy layers should not share a stage with everything
+    assert bounds[0] == 0 and bounds[-1] == 4
+    w = [100, 1, 1, 100]
+    stage_weights = [sum(w[bounds[i]:bounds[i + 1]]) for i in range(2)]
+    assert max(stage_weights) <= 102
+
+
+def test_partition_by_type_regex():
+    specs = [LayerSpec(build=lambda: None, name=n) for n in
+             ["embed", "block", "block", "block", "block", "head"]]
+    bounds = partition_layers(specs, 2, "type:block")
+    s0 = [specs[i].name for i in range(bounds[0], bounds[1])]
+    assert s0.count("block") == 2  # blocks split evenly
+
+
+# ---------------------------------------------------------------------------
+# fused executor
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def stage_mesh():
+    grid = initialize_mesh(stage=4, data=2)
+    set_current_mesh(grid.mesh)
+    yield grid
+    set_current_mesh(None)
+
+
+def test_pipeline_apply_matches_sequential(stage_mesh):
+    rng = np.random.default_rng(0)
+    L, B, s, d = 8, 4, 8, 16
+    w = jnp.asarray(rng.normal(size=(L, d, d)) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, s, d)), jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    out = jax.jit(
+        lambda w, x: pipeline_apply(w, x, layer_fn, num_stages=4, num_micro=4)
+    )(w, x)
+    ref = x
+    for i in range(L):
+        ref = layer_fn(ref, w[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_apply_grads_match(stage_mesh):
+    rng = np.random.default_rng(1)
+    L, B, s, d = 4, 4, 4, 8
+    w = jnp.asarray(rng.normal(size=(L, d, d)) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, s, d)), jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    def loss_pipe(w):
+        return jnp.sum(pipeline_apply(w, x, layer_fn, 4, 2) ** 2)
+
+    def loss_seq(w):
+        h = x
+        for i in range(L):
+            h = layer_fn(h, w[i])
+        return jnp.sum(h ** 2)
+
+    gp = jax.jit(jax.grad(loss_pipe))(w)
+    gs = jax.grad(loss_seq)(w)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gs), atol=1e-4, rtol=1e-4)
+
+
+def test_pipelined_causal_lm_matches_dense(stage_mesh):
+    cfg = get_preset("tiny", num_layers=4)
+    dense = CausalLM(cfg)
+    piped = PipelinedCausalLM(cfg, num_stages=4, num_micro=2)
+    params = dense.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 64, (4, 17)))}
+    l_dense = float(jax.jit(dense.loss_fn)(params, batch))
+    l_piped = float(jax.jit(piped.loss_fn)(params, batch))
+    assert abs(l_dense - l_piped) < 2e-3, (l_dense, l_piped)
+
+
+def test_pipelined_trains_end_to_end(stage_mesh):
+    import deepspeed_tpu as ds
+
+    cfg = get_preset("tiny", num_layers=4)
+    model = PipelinedCausalLM(cfg, num_stages=4, num_micro=2)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, mesh=stage_mesh)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (1, 4, 17), dtype=np.int64)}
+    first = float(engine.train_batch(batch))
+    for _ in range(15):
+        loss = float(engine.train_batch(batch))
+    assert loss < first * 0.8, (first, loss)
