@@ -1,0 +1,9 @@
+"""Inference: v1-style dense-cache engine + v2 paged continuous batching.
+
+reference: deepspeed/inference/ (engine.py v1; v2 ragged engine
+engine_v2.py:30 + ragged state in inference/v2/ragged/).
+"""
+from .engine import InferenceEngine, init_inference  # noqa: F401
+from .engine_v2 import InferenceEngineV2  # noqa: F401
+from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
+from .sampling import SamplingParams, sample  # noqa: F401

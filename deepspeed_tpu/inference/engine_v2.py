@@ -1,0 +1,188 @@
+"""Continuous-batching inference engine (the FastGen-core analogue).
+
+Port of the reference's ``InferenceEngineV2`` serving surface
+(``inference/v2/engine_v2.py``): ``put(uids, tokens)`` admits/steps work
+(:107), ``query``/``can_schedule`` do KV-block admission control
+(:158/:184), ``flush`` releases sequences.  The execution model is
+TPU-shaped: static-shape compiled functions — bucketed prefill (prompt
+padded to the next bucket) + one batched decode kernel over the fixed slot
+array — with host-side block bookkeeping (ragged.py) driving them, the
+Dynamic-SplitFuse-style fixed token budget replaced by one-prefill-per-put
++ batched decode ticks.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+from ..utils.logging import log_dist
+from . import model_runner
+from .paged import init_paged_cache
+from .ragged import StateManager
+from .sampling import SamplingParams, sample
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")
+
+
+class InferenceEngineV2:
+    """Paged-KV continuous-batching engine for one model replica."""
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        max_seqs: int = 8,
+        num_blocks: int = 256,
+        block_size: int = 32,
+        max_seq_len: Optional[int] = None,
+        prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048, 4096),
+        seed: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.block_size = block_size
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        self.max_pages = -(-self.max_seq_len // block_size)
+        self.mgr = StateManager(num_blocks, block_size, max_seqs)
+        self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
+        self.kv = init_paged_cache(
+            cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd,
+            dtype=cfg.dtype,
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        # static-batch decode tensors
+        self.block_tables = jnp.full((max_seqs, self.max_pages), -1, jnp.int32)
+
+        # params are explicit jit arguments — closing over them would inline
+        # every weight into the HLO as a constant (huge programs, no donation)
+        cfg_ = self.cfg
+
+        def prefill_impl(params, tokens, length, blocks, kv):
+            return model_runner.prefill(params, cfg_, tokens, length, blocks, kv)
+
+        def decode_impl(params, tokens, seq_lens, block_tables, active, kv):
+            return model_runner.decode_step(
+                params, cfg_, tokens, seq_lens, block_tables, active, kv
+            )
+
+        self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(4,))
+        self._decode_jit = jax.jit(decode_impl, donate_argnums=(5,))
+
+    # -- scheduling queries (reference engine_v2.py:158/:184) --------------
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(max admissible new tokens, free blocks) — admission info."""
+        free = self.mgr.allocator.free_blocks
+        return free * self.block_size, free
+
+    def can_schedule(self, prompt_lens: Sequence[int]) -> bool:
+        blocks = sum(-(-p // self.block_size) for p in prompt_lens)
+        return (
+            len(self.mgr.seqs) + len(prompt_lens) <= self.mgr.max_seqs
+            and blocks <= self.mgr.allocator.free_blocks
+        )
+
+    # -- serving API -------------------------------------------------------
+    def put(
+        self,
+        uids: Sequence[int],
+        token_lists: Sequence[Sequence[int]],
+        sampling: SamplingParams = SamplingParams(),
+    ) -> Dict[int, int]:
+        """Admit new sequences, run their prefills, return {uid: first_token}."""
+        out = {}
+        for uid, toks in zip(uids, token_lists):
+            toks = list(map(int, toks))
+            if not self.mgr.can_admit(len(toks)):
+                raise RuntimeError(
+                    f"cannot admit uid={uid} (len {len(toks)}): out of KV blocks/slots"
+                )
+            seq = self.mgr.admit(uid, toks)
+            self.mgr.ensure_capacity(seq, 0)
+            s_pad = _bucket(len(toks), self.prefill_buckets)
+            padded = np.zeros(s_pad, np.int32)
+            padded[: len(toks)] = toks
+            n_pages_pad = -(-s_pad // self.block_size)
+            blocks = np.full(n_pages_pad, -1, np.int32)
+            blocks[: len(seq.blocks)] = seq.blocks
+            logits, self.kv = self._prefill_jit(
+                self.params, jnp.asarray(padded), jnp.asarray(len(toks)),
+                jnp.asarray(blocks), self.kv,
+            )
+            seq.seen_tokens = len(toks)
+            self._rng, sub = jax.random.split(self._rng)
+            tok = int(sample(logits[None], sampling, sub)[0])
+            seq.tokens.append(tok)
+            self._set_block_table(seq)
+            out[uid] = tok
+        return out
+
+    def _set_block_table(self, seq) -> None:
+        row = np.full(self.max_pages, -1, np.int32)
+        row[: len(seq.blocks)] = seq.blocks
+        self.block_tables = self.block_tables.at[seq.slot].set(jnp.asarray(row))
+
+    def step(self, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
+        """One batched decode tick over all active sequences; returns the
+        next token per uid (sequences at their stop token are skipped)."""
+        active_seqs = [s for s in self.mgr.active if not s.done]
+        if not active_seqs:
+            return {}
+        B = self.mgr.max_seqs
+        tokens = np.zeros(B, np.int32)
+        seq_lens = np.zeros(B, np.int32)
+        active = np.zeros(B, bool)
+        for s in active_seqs:
+            # grow pages for the token being written this tick
+            self.mgr.ensure_capacity(s, 1)
+            self._set_block_table(s)
+            tokens[s.slot] = s.tokens[-1]
+            seq_lens[s.slot] = s.cur_len - 1  # KV position of the new token
+            active[s.slot] = True
+        logits, self.kv = self._decode_jit(
+            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+            self.block_tables, jnp.asarray(active), self.kv,
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        next_tokens = np.asarray(sample(logits, sampling, sub))
+        out = {}
+        for s in active_seqs:
+            tok = int(next_tokens[s.slot])
+            s.tokens.append(tok)
+            s.seen_tokens = s.cur_len - 1
+            out[s.uid] = tok
+            if sampling.stop_token is not None and tok == sampling.stop_token:
+                s.done = True
+            if s.cur_len >= self.max_seq_len:
+                s.done = True
+        return out
+
+    def flush(self, uids: Sequence[int]) -> None:
+        for uid in uids:
+            self.mgr.release(uid)
+
+    # -- convenience (v1-style generate) -----------------------------------
+    def generate(
+        self, prompt_tokens: Sequence[int], sampling: SamplingParams = SamplingParams()
+    ) -> List[int]:
+        uid = max(self.mgr.seqs, default=0) + 1
+        first = self.put([uid], [prompt_tokens], sampling)[uid]
+        n = len(prompt_tokens)
+        while True:
+            seq = self.mgr.seqs[uid]
+            if seq.done or seq.cur_len - n >= sampling.max_new_tokens:
+                break
+            self.step(sampling)
+        toks = self.mgr.seqs[uid].tokens[n:]
+        self.flush([uid])
+        if sampling.stop_token is not None and toks and toks[-1] == sampling.stop_token:
+            toks = toks[:-1]
+        return toks[: sampling.max_new_tokens]

@@ -1,0 +1,148 @@
+"""Inference model runner: prefill + batched decode against the paged cache.
+
+The analogue of the reference's per-family inference model implementations
+(``inference/v2/model_implementations/llama_v2`` etc.) — but one generic
+runner covers every ``TransformerConfig`` family, because architecture
+switches live in the config, not in code.  Reuses the training model's
+building blocks (norm / rope / mlp_block / moe_block) with its own attention
+wiring, mirroring how the reference keeps training and inference model code
+separate (module_inject containers vs training nn.Modules).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import (
+    TransformerConfig,
+    _activation,
+    head_kernel,
+    mlp_block,
+    norm,
+    rope,
+)
+from ..ops.attention import dot_product_attention
+from .paged import paged_attention_decode, write_decode_kv, write_prefill_kv
+
+Params = Any
+
+
+def _qkv(lw, x, cfg: TransformerConfig):
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    q = x @ lw["wq"]
+    k = x @ lw["wk"]
+    v = x @ lw["wv"]
+    if cfg.qkv_bias:
+        q, k, v = q + lw["bq"], k + lw["bk"], v + lw["bv"]
+    return (
+        q.reshape(b, s, hq, hd),
+        k.reshape(b, s, hkv, hd),
+        v.reshape(b, s, hkv, hd),
+    )
+
+
+def _ffn(lw, x, cfg):
+    if cfg.moe_num_experts > 0:
+        from ..moe.layer import moe_block
+
+        out, _ = moe_block(lw["moe"], x, cfg)
+        return out
+    return mlp_block(lw["mlp"], x, cfg)
+
+
+def prefill(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [s_pad] int32 (one sequence, padded)
+    length: jnp.ndarray,  # scalar — true prompt length
+    blocks: jnp.ndarray,  # [n_pages] int32, -1 padded
+    kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+):
+    """Run the prompt, write its KV pages, return (logits_at_last, caches).
+
+    Dense causal attention over the padded prompt (padding masked by
+    causality + the final gather at ``length - 1``).
+    """
+    s = tokens.shape[0]
+    x = params["embed"]["embedding"][tokens][None].astype(cfg.dtype)  # [1,s,d]
+    positions = jnp.arange(s)[None]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"]["embedding"][jnp.arange(s)][None].astype(cfg.dtype)
+    ck, cv = kv_cache
+    # python loop over layers: each layer writes its cache page slab.
+    # (L is static; unrolled trace is fine for inference graphs)
+    new_ck, new_cv = ck, cv
+    for l in range(cfg.num_layers):
+        lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(lw["attn"], h, cfg)
+        if cfg.position == "rope":
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+        new_ck = new_ck.at[l].set(
+            write_prefill_kv(new_ck[l], k[0].astype(new_ck.dtype), blocks, length)
+        )
+        new_cv = new_cv.at[l].set(
+            write_prefill_kv(new_cv[l], v[0].astype(new_cv.dtype), blocks, length)
+        )
+        attn = dot_product_attention(
+            q, k, v, causal=True, logits_soft_cap=cfg.logits_soft_cap
+        )
+        attn = attn.reshape(1, s, -1) @ lw["attn"]["wo"]
+        x = x + attn.astype(x.dtype)
+        h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    last = x[0, jnp.clip(length - 1, 0, s - 1)]  # [d]
+    logits = last @ head_kernel(params, cfg)  # [v]
+    return logits.astype(jnp.float32), (new_ck, new_cv)
+
+
+def decode_step(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [B] int32 — last sampled token per slot
+    seq_lens: jnp.ndarray,  # [B] int32 — length BEFORE this token
+    block_tables: jnp.ndarray,  # [B, P] int32
+    active: jnp.ndarray,  # [B] bool
+    kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+):
+    """One batched decode tick: returns (logits [B, v], new caches)."""
+    b = tokens.shape[0]
+    x = params["embed"]["embedding"][tokens][:, None].astype(cfg.dtype)  # [B,1,d]
+    positions = seq_lens[:, None]  # the new token's position
+    if cfg.position == "learned":
+        pe = params["pos_embed"]["embedding"][
+            jnp.clip(seq_lens, 0, cfg.max_seq_len - 1)
+        ]
+        x = x + pe[:, None].astype(cfg.dtype)
+    ck, cv = kv_cache
+    new_ck, new_cv = ck, cv
+    for l in range(cfg.num_layers):
+        lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(lw["attn"], h, cfg)  # [B,1,h,hd]
+        if cfg.position == "rope":
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+        new_ck = new_ck.at[l].set(
+            write_decode_kv(new_ck[l], k[:, 0], block_tables, seq_lens, active)
+        )
+        new_cv = new_cv.at[l].set(
+            write_decode_kv(new_cv[l], v[:, 0], block_tables, seq_lens, active)
+        )
+        attn = paged_attention_decode(
+            q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1
+        )
+        attn = attn.reshape(b, 1, -1) @ lw["attn"]["wo"]
+        x = x + attn.astype(x.dtype)
+        h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    logits = x[:, 0] @ head_kernel(params, cfg)
+    return logits.astype(jnp.float32), (new_ck, new_cv)

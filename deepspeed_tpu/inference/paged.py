@@ -1,0 +1,88 @@
+"""Paged KV cache: device-side block pool + gather-based paged attention.
+
+TPU-native counterpart of the reference's paged KV machinery
+(``inference/v2/ragged/kv_cache.py`` + the blocked attention kernels in
+``inference/v2/kernels/ragged_ops``).  The cache is one block pool per
+layer stack — [L, num_blocks, block_size, hkv, hd] — and block tables map
+each sequence slot to its pages.  Attention gathers a sequence's pages into
+a contiguous [max_len] view and masks; static shapes throughout (the
+max_blocks_per_seq bound plays the role of the reference's
+max_ragged_sequence_count), so one compiled kernel serves every step.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import repeat_kv
+
+
+def init_paged_cache(
+    num_layers: int, num_blocks: int, block_size: int, num_kv_heads: int,
+    head_dim: int, dtype=jnp.bfloat16,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def write_prefill_kv(cache_layer, kv, blocks, length):
+    """Scatter a prompt's K (or V) [s_pad, hkv, hd] into its pages.
+
+    cache_layer [num_blocks, bs, hkv, hd]; blocks [n_pages] int32 (padded
+    with -1 past the prompt).  Invalid pages are routed to an out-of-bounds
+    sentinel and dropped by the scatter — mapping them to a "safe" real
+    block would alias whichever sequence owns that block.  Rows past
+    ``length`` inside the last valid page hold padding garbage; attention
+    masks them by sequence length so they are never read.
+    """
+    nb, bs = cache_layer.shape[0], cache_layer.shape[1]
+    n_pages = blocks.shape[0]
+    kvp = kv.reshape(n_pages, bs, *kv.shape[1:]).astype(cache_layer.dtype)
+    sentinel = jnp.where(blocks >= 0, blocks, nb)  # nb is out of bounds
+    return cache_layer.at[sentinel].set(kvp, mode="drop")
+
+
+def write_decode_kv(cache_layer, kv, block_table, positions, active):
+    """Scatter one new token per sequence.
+
+    cache_layer [num_blocks, bs, hkv, hd]; kv [B, hkv, hd];
+    block_table [B, max_pages]; positions [B] (token index being written);
+    active [B] bool — inactive slots are dropped from the scatter.
+    """
+    nb, bs = cache_layer.shape[0], cache_layer.shape[1]
+    b = kv.shape[0]
+    page = block_table[jnp.arange(b), positions // bs]  # [B]
+    off = positions % bs
+    # inactive slots scatter to an out-of-bounds sentinel and are dropped
+    # (a "safe" real page would alias another sequence's block)
+    sentinel = jnp.where(active & (page >= 0), page, nb)
+    return cache_layer.at[sentinel, off].set(kv.astype(cache_layer.dtype), mode="drop")
+
+
+def paged_attention_decode(
+    q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
+):
+    """Single-token attention against paged KV.
+
+    q [B, hq, hd]; cache_*_layer [num_blocks, bs, hkv, hd];
+    block_table [B, P]; seq_lens [B] (length INCLUDING the current token).
+    Returns [B, hq, hd].
+    """
+    b, hq, hd = q.shape
+    nb, bs, hkv, _ = cache_k_layer.shape
+    p = block_table.shape[1]
+    safe = jnp.clip(block_table, 0, nb - 1)
+    k = cache_k_layer[safe].reshape(b, p * bs, hkv, hd)
+    v = cache_v_layer[safe].reshape(b, p * bs, hkv, hd)
+    k = repeat_kv(k, hq // hkv)
+    v = repeat_kv(v, hq // hkv)
+    scale = scale if scale is not None else float(hd) ** -0.5
+    logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(p * bs)[None, :] < seq_lens[:, None]
+    logits = jnp.where(mask[:, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

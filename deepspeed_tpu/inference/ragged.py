@@ -1,0 +1,111 @@
+"""Ragged-batching state: blocked KV allocator, sequence descriptors,
+state manager.
+
+Port of the reference inference-v2 host-side design — the clean abstractions
+SURVEY §7 says to keep: ``BlockedAllocator``
+(inference/v2/ragged/blocked_allocator.py), ``DSSequenceDescriptor``
+(sequence_descriptor.py), ``DSStateManager`` (ragged_manager.py:19).  All
+host-side Python; device state is the paged KV cache (paged.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Fixed pool of KV blocks managed as a free list
+    (reference: blocked_allocator.py — same int-linked-list design)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least one block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"cannot allocate {n} blocks ({len(self._free)} free)")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+
+
+@dataclass
+class SequenceDescriptor:
+    """Tracked state of one generation request
+    (reference: sequence_descriptor.py DSSequenceDescriptor)."""
+
+    uid: int
+    slot: int  # row in the engine's static batch tensors
+    blocks: List[int] = field(default_factory=list)
+    seen_tokens: int = 0  # tokens whose KV is already in the cache
+    tokens: List[int] = field(default_factory=list)  # full token history
+    done: bool = False
+
+    @property
+    def cur_len(self) -> int:
+        return len(self.tokens)
+
+
+class StateManager:
+    """Owns the allocator + uid->descriptor map and the block arithmetic
+    (reference: ragged_manager.py DSStateManager)."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_seqs: int):
+        self.block_size = block_size
+        self.allocator = BlockedAllocator(num_blocks)
+        self.max_seqs = max_seqs
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self._free_slots = list(range(max_seqs))
+
+    def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
+        have = len(seq.blocks) * self.block_size
+        need = seq.cur_len + new_tokens
+        return max(0, -(-(need - have) // self.block_size))
+
+    def can_admit(self, prompt_len: int) -> bool:
+        blocks = -(-prompt_len // self.block_size)
+        return bool(self._free_slots) and blocks <= self.allocator.free_blocks
+
+    def admit(self, uid: int, prompt_tokens: List[int]) -> SequenceDescriptor:
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already tracked")
+        if not self._free_slots:
+            raise RuntimeError("no free sequence slots")
+        seq = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(0))
+        seq.tokens = list(prompt_tokens)
+        self.seqs[uid] = seq
+        return seq
+
+    def ensure_capacity(self, seq: SequenceDescriptor, new_tokens: int) -> None:
+        n = self.blocks_needed(seq, new_tokens)
+        if n:
+            seq.blocks.extend(self.allocator.allocate(n))
+
+    def release(self, uid: int) -> None:
+        seq = self.seqs.pop(uid)
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+        self._free_slots.append(seq.slot)
+
+    @property
+    def active(self) -> List[SequenceDescriptor]:
+        return sorted(self.seqs.values(), key=lambda s: s.slot)

@@ -315,11 +315,15 @@ def forward(
     cache: Optional[Params] = None,
     cache_index: Optional[jnp.ndarray] = None,
     return_hidden: bool = False,
+    stack_apply: Optional[Callable] = None,
 ) -> Tuple[jnp.ndarray, Optional[Params], jnp.ndarray]:
     """tokens [b, s] -> (logits [b, s, v] | hidden, new_cache, moe_aux_loss).
 
     The L layers run as one ``lax.scan`` over the stacked layer params; the
     scanned body is optionally wrapped in ``jax.checkpoint`` per ``cfg.remat``.
+    ``stack_apply(layer_params, x, positions) -> x`` overrides the decoder
+    stack execution (the pipeline-parallel executor hooks in here); caches
+    and MoE aux losses are unsupported on that path.
     """
     attn_fn = _get_attn_fn(cfg)
     b, s = tokens.shape
@@ -332,26 +336,30 @@ def forward(
         x = x + params["pos_embed"]["embedding"][positions].astype(cfg.dtype)
     x = shard_activation(x, ACT_SPEC)
 
-    def body(carry, scanned):
-        h = carry
-        lw, layer_cache = scanned
-        h, new_cache, aux = decoder_layer(
-            lw, h, cfg, positions, attn_fn, segment_ids, layer_cache, cache_index
-        )
-        return h, (new_cache, aux)
+    if stack_apply is not None:
+        x = stack_apply(params["layers"], x, positions)
+        new_caches, aux_loss = None, jnp.asarray(0.0, jnp.float32)
+    else:
+        def body(carry, scanned):
+            h = carry
+            lw, layer_cache = scanned
+            h, new_cache, aux = decoder_layer(
+                lw, h, cfg, positions, attn_fn, segment_ids, layer_cache, cache_index
+            )
+            return h, (new_cache, aux)
 
-    if cfg.remat == "full":
-        body = jax.checkpoint(body, prevent_cse=False)
-    elif cfg.remat == "dots":
-        body = jax.checkpoint(
-            body,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            prevent_cse=False,
-        )
+        if cfg.remat == "full":
+            body = jax.checkpoint(body, prevent_cse=False)
+        elif cfg.remat == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                prevent_cse=False,
+            )
 
-    layer_params = params["layers"]
-    x, (new_caches, aux_losses) = jax.lax.scan(body, x, (layer_params, cache))
-    aux_loss = jnp.sum(aux_losses)
+        layer_params = params["layers"]
+        x, (new_caches, aux_losses) = jax.lax.scan(body, x, (layer_params, cache))
+        aux_loss = jnp.sum(aux_losses)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     if return_hidden:
@@ -396,8 +404,9 @@ class CausalLM:
     {'input_ids', 'labels'} for pre-shifted data.
     """
 
-    def __init__(self, cfg: TransformerConfig):
+    def __init__(self, cfg: TransformerConfig, stack_apply: Optional[Callable] = None):
         self.cfg = cfg
+        self.stack_apply = stack_apply
 
     def init_params(self, rng) -> Params:
         return init_params(rng, self.cfg)
@@ -418,14 +427,18 @@ class CausalLM:
             from ..sequence.cross_entropy import chunked_cross_entropy
 
             hidden, _, aux = forward(
-                params, inputs, self.cfg, segment_ids=segment_ids, return_hidden=True
+                params, inputs, self.cfg, segment_ids=segment_ids,
+                return_hidden=True, stack_apply=self.stack_apply,
             )
             loss = chunked_cross_entropy(
                 hidden, head_kernel(params, self.cfg), labels,
                 chunk_size=self.cfg.loss_chunk_size,
             )
         else:
-            logits, _, aux = forward(params, inputs, self.cfg, segment_ids=segment_ids)
+            logits, _, aux = forward(
+                params, inputs, self.cfg, segment_ids=segment_ids,
+                stack_apply=self.stack_apply,
+            )
             loss = cross_entropy_loss(logits, labels)
         if self.cfg.moe_num_experts > 0:
             loss = loss + self.cfg.moe_aux_loss_coef * aux / max(self.cfg.num_layers, 1)

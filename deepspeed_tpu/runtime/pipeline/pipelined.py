@@ -53,6 +53,14 @@ def pipeline_apply(
     Returns activations [B, ...] after all L layers.
     """
     mesh = mesh if mesh is not None else get_current_mesh()
+    if mesh is None:
+        raise ValueError("pipeline_apply needs a mesh (set_current_mesh or mesh=)")
+    mesh_stage = dict(zip(mesh.axis_names, mesh.devices.shape)).get(STAGE_AXIS, 1)
+    if mesh_stage != num_stages:
+        raise ValueError(
+            f"num_stages={num_stages} but mesh '{STAGE_AXIS}' axis has size "
+            f"{mesh_stage} — they must match"
+        )
     L = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
     if L % num_stages:
         raise ValueError(f"{L} layers not divisible by {num_stages} stages")
@@ -148,10 +156,15 @@ class PipelinedCausalLM:
                 "(the aux load-balancing loss would be silently dropped); "
                 "compose MoE with ZeRO/TP/SP instead"
             )
+        if cfg.sequence_parallel != "none":
+            raise NotImplementedError(
+                "sequence_parallel inside the pipelined stack is not supported "
+                "(nested shard_map); compose SP with ZeRO/TP instead"
+            )
         self.cfg = cfg
         self.num_stages = num_stages
         self.num_micro = num_micro
-        self._inner = CausalLM(cfg)
+        self._inner = CausalLM(cfg, stack_apply=self._stack_apply)
 
     def init_params(self, rng):
         return self._inner.init_params(rng)
@@ -176,7 +189,10 @@ class PipelinedCausalLM:
         rules.append((r"^layers/", P(STAGE_AXIS)))
         return rules
 
-    def apply_stack(self, params, x, positions):
+    def _stack_apply(self, layer_params, x, positions):
+        """The hook ``models.transformer.forward`` calls instead of its
+        lax.scan — everything else (embed, loss, chunked CE) is the dense
+        path, unduplicated."""
         from ...models.transformer import decoder_layer
         from ...ops.attention import get_attention_impl
 
@@ -190,42 +206,13 @@ class PipelinedCausalLM:
             return h
 
         return pipeline_apply(
-            params["layers"], x, layer_fn, self.num_stages, self.num_micro
+            layer_params, x, layer_fn, self.num_stages, self.num_micro
         )
 
     def loss_fn(self, params, batch, rng=None):
-        from ...models.transformer import (
-            cross_entropy_loss,
-            head_kernel,
-            norm,
-            shard_activation,
-        )
-        from ...models.transformer import ACT_SPEC
-
         if "segment_ids" in batch:
             raise NotImplementedError(
                 "packed-sequence segment_ids are not supported in the "
                 "pipelined stack (per-microbatch segment routing pending)"
             )
-        tokens = batch["input_ids"]
-        if "labels" in batch:
-            inputs, labels = tokens, batch["labels"]
-        else:
-            inputs, labels = tokens[:, :-1], tokens[:, 1:]
-        b, s = inputs.shape
-        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-        x = params["embed"]["embedding"][inputs].astype(self.cfg.dtype)
-        if self.cfg.position == "learned":
-            x = x + params["pos_embed"]["embedding"][positions].astype(self.cfg.dtype)
-        x = shard_activation(x, ACT_SPEC)
-        x = self.apply_stack(params, x, positions)
-        x = norm(x, params["final_norm"], self.cfg.norm, self.cfg.norm_eps)
-        if self.cfg.loss_chunk_size:
-            from ...sequence.cross_entropy import chunked_cross_entropy
-
-            return chunked_cross_entropy(
-                x, head_kernel(params, self.cfg), labels,
-                chunk_size=self.cfg.loss_chunk_size,
-            )
-        logits = x @ head_kernel(params, self.cfg)
-        return cross_entropy_loss(logits, labels)
+        return self._inner.loss_fn(params, batch, rng)

@@ -148,13 +148,17 @@ class TrainSchedule(PipeSchedule):
         return max(2, min(self.stages - self.stage_id, self.micro_batches))
 
     def _step_to_micro_batch(self, step_id: int):
-        """Even steps are forwards, odd steps backwards (reference
-        schedule.py:236-263)."""
-        if step_id % 2 == 0:
-            mb = step_id // 2 - self.stage_id
-            return mb, True
-        mb = (step_id - 1) // 2 - (self.stages - self.stage_id - 1)
-        return mb, False
+        """Stage-parity interleave (1F1B, reference schedule.py:236-263):
+        stage s forwards microbatch m at step ``s + 2m`` (steps of parity
+        s%2) and backwards it at step ``2S - 1 - s + 2m`` (opposite parity),
+        so each stage's forward lands one step after its predecessor
+        produced the activation, the last stage backwards immediately after
+        its forward, and grads flow down one stage per step."""
+        even_step = step_id % 2 == 0
+        even_stage = self.stage_id % 2 == 0
+        if even_step == even_stage:  # forward step for this stage
+            return (step_id - self.stage_id) // 2, True
+        return (step_id - (2 * self.stages - 1 - self.stage_id)) // 2, False
 
     def steps(self):
         prev_mb = -1

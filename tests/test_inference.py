@@ -1,0 +1,152 @@
+"""Inference stack: allocator/state-manager unit tests (reference
+tests/unit/inference/v2/ragged/), paged-vs-dense decode parity, continuous
+batching, sampling."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (
+    BlockedAllocator,
+    InferenceEngine,
+    InferenceEngineV2,
+    SamplingParams,
+    StateManager,
+    init_inference,
+    sample,
+)
+from deepspeed_tpu.models import CausalLM, get_preset
+
+
+# ---------------------------------------------------------------------------
+# host-side state
+# ---------------------------------------------------------------------------
+def test_blocked_allocator():
+    a = BlockedAllocator(8)
+    got = a.allocate(3)
+    assert len(got) == 3 and a.free_blocks == 5
+    a.free(got)
+    assert a.free_blocks == 8
+    with pytest.raises(ValueError):
+        a.free(got[:1] + got[:1])  # double free in one call is caught per-id
+    a2 = BlockedAllocator(2)
+    a2.allocate(2)
+    with pytest.raises(RuntimeError):
+        a2.allocate(1)
+
+
+def test_state_manager_block_math():
+    m = StateManager(num_blocks=16, block_size=4, max_seqs=2)
+    s = m.admit(1, [1, 2, 3, 4, 5])  # 5 tokens -> 2 blocks
+    m.ensure_capacity(s, 0)
+    assert len(s.blocks) == 2
+    m.ensure_capacity(s, 3)  # 8 tokens still 2 blocks
+    assert len(s.blocks) == 2
+    m.ensure_capacity(s, 4)  # 9 tokens -> 3 blocks
+    assert len(s.blocks) == 3
+    assert m.can_admit(4)
+    m.release(1)
+    assert m.allocator.free_blocks == 16
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+def test_sampling_greedy_and_topk():
+    logits = jnp.asarray([[1.0, 5.0, 2.0, 0.0]])
+    assert int(sample(logits, SamplingParams(), jax.random.PRNGKey(0))[0]) == 1
+    # top-k=1 at any temperature must pick the argmax
+    p = SamplingParams(temperature=1.0, top_k=1)
+    assert int(sample(logits, p, jax.random.PRNGKey(0))[0]) == 1
+    # top-p tiny keeps only the argmax
+    p = SamplingParams(temperature=1.0, top_p=0.01)
+    assert int(sample(logits, p, jax.random.PRNGKey(1))[0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    # fp32 compute: greedy-parity tests on an untrained model would otherwise
+    # flip argmax on bf16 near-ties
+    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_v1_engine_greedy_matches_forward(tiny_model):
+    model, params = tiny_model
+    eng = init_inference(model, params)
+    prompt = np.asarray([[5, 7, 9, 11]], np.int32)
+    out = eng.generate(prompt, SamplingParams(max_new_tokens=4))
+    assert out.shape == (1, 4)
+    # teacher-forced check: feeding prompt+gen reproduces the gen greedily
+    from deepspeed_tpu.models.transformer import forward
+
+    full = np.concatenate([prompt, out], axis=1)
+    logits, _, _ = forward(params, jnp.asarray(full), model.cfg)
+    for i in range(4):
+        step_logits = logits[0, prompt.shape[1] - 1 + i]
+        assert int(jnp.argmax(step_logits)) == int(full[0, prompt.shape[1] + i])
+
+
+def test_v2_paged_matches_v1_dense(tiny_model):
+    model, params = tiny_model
+    v1 = init_inference(model, params)
+    v2 = InferenceEngineV2(params, model.cfg, max_seqs=2, num_blocks=64,
+                           block_size=8, prefill_buckets=(16, 32))
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    n = 6
+    dense = v1.generate(np.asarray([prompt], np.int32),
+                        SamplingParams(max_new_tokens=n))[0].tolist()
+    paged = v2.generate(prompt, SamplingParams(max_new_tokens=n))
+    assert dense == paged, (dense, paged)
+
+
+def test_v2_continuous_batching_parity(tiny_model):
+    """Two concurrent sequences must decode exactly as they do alone."""
+    model, params = tiny_model
+    p1 = [3, 1, 4, 1, 5]
+    p2 = [2, 7, 1, 8, 2, 8, 1]
+    solo = {}
+    for uid, p in [(1, p1), (2, p2)]:
+        eng = InferenceEngineV2(params, model.cfg, max_seqs=2, num_blocks=64,
+                                block_size=8, prefill_buckets=(16,))
+        solo[uid] = eng.generate(p, SamplingParams(max_new_tokens=5))
+
+    eng = InferenceEngineV2(params, model.cfg, max_seqs=2, num_blocks=64,
+                            block_size=8, prefill_buckets=(16,))
+    first = eng.put([1, 2], [p1, p2])
+    gen = {1: [first[1]], 2: [first[2]]}
+    for _ in range(4):
+        for uid, tok in eng.step().items():
+            gen[uid].append(tok)
+    assert gen[1] == solo[1] and gen[2] == solo[2], (gen, solo)
+
+
+def test_v2_block_growth_across_pages(tiny_model):
+    """Generation crossing block boundaries stays consistent."""
+    model, params = tiny_model
+    v1 = init_inference(model, params)
+    v2 = InferenceEngineV2(params, model.cfg, max_seqs=1, num_blocks=32,
+                           block_size=4, prefill_buckets=(8,))  # tiny pages
+    prompt = [3, 1, 4, 1, 5, 9]
+    n = 10  # crosses multiple 4-token pages
+    dense = v1.generate(np.asarray([prompt], np.int32),
+                        SamplingParams(max_new_tokens=n))[0].tolist()
+    paged = v2.generate(prompt, SamplingParams(max_new_tokens=n))
+    assert dense == paged, (dense, paged)
+
+
+def test_v2_admission_control(tiny_model):
+    model, params = tiny_model
+    v2 = InferenceEngineV2(params, model.cfg, max_seqs=1, num_blocks=4,
+                           block_size=4, prefill_buckets=(16,))
+    assert v2.can_schedule([8])
+    assert not v2.can_schedule([32])  # needs 8 blocks, only 4 exist
+    v2.put([1], [[1, 2, 3, 4, 5]])
+    assert not v2.can_schedule([4])  # no free slots (max_seqs=1)
+    v2.flush([1])
+    assert v2.can_schedule([8])

@@ -41,6 +41,34 @@ def test_train_schedule_covers_all_microbatches():
             assert opt == [len(steps) - 1]
 
 
+def test_train_schedule_forward_precedes_backward():
+    """Per stage: BackwardPass(mb) must come after its own ForwardPass(mb),
+    and after the NEXT stage had a step to backward it first (1F1B order)."""
+    for stages, mbs in [(2, 4), (4, 8), (3, 6)]:
+        for sid in range(stages):
+            fwd_step = {}
+            for i, step in enumerate(TrainSchedule(mbs, stages, sid)):
+                for c in step:
+                    name = type(c).__name__
+                    if name == "ForwardPass":
+                        fwd_step[c.buffer_id, "mb", i] = i
+                        fwd_step.setdefault(("f", i), i)
+            # re-walk checking ordering by micro-batch id via _step_to_micro_batch
+            sched = TrainSchedule(mbs, stages, sid)
+            seen_fwd = set()
+            for i in range(2 * (mbs + stages - 1)):
+                mb, is_fwd = sched._step_to_micro_batch(i)
+                if not (0 <= mb < mbs):
+                    continue
+                if is_fwd:
+                    seen_fwd.add(mb)
+                else:
+                    assert mb in seen_fwd, (
+                        f"stage {sid}/{stages}: backward mb{mb} at step {i} "
+                        f"before its forward"
+                    )
+
+
 def test_train_schedule_first_stage_loads_batches():
     steps = _instr_types(TrainSchedule(4, 2, 0))
     loads = sum(s.count("LoadMicroBatch") for s in steps)
