@@ -24,11 +24,21 @@ def collect() -> dict:
         except ImportError:
             info[mod] = "MISSING"
     try:
-        from ..ops.pallas import is_pallas_supported
+        from ..ops.pallas import on_tpu
 
-        info["pallas"] = "supported" if is_pallas_supported() else "interpret-mode only"
+        info["pallas"] = "tpu kernels" if on_tpu() else "interpret-mode only"
     except Exception:
         info["pallas"] = "unknown"
+    try:
+        from ..ops.op_builder import op_report
+
+        for name, st in op_report().items():
+            info[f"op/{name}"] = (
+                ("compatible" if st["compatible"] else "INCOMPATIBLE")
+                + (", built" if st["built"] else "")
+            )
+    except Exception as e:
+        info["native_ops"] = f"error: {e}"
     import deepspeed_tpu
 
     info["deepspeed_tpu"] = deepspeed_tpu.__version__

@@ -1,0 +1,7 @@
+"""DeepNVMe-equivalent: async file I/O + tensor swapping to local SSD.
+
+reference: deepspeed/nvme/ (ds_io bench), csrc/aio/ (engine),
+runtime/swap_tensor/ (partitioned param/optimizer swappers).
+"""
+from .aio import AsyncIOEngine  # noqa: F401
+from .swap import TensorSwapper  # noqa: F401

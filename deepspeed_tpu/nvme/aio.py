@@ -1,0 +1,81 @@
+"""Python facade over the C++ async I/O engine.
+
+reference: csrc/aio/py_lib/py_ds_aio.cpp (DeepSpeedAIO binding) +
+deepspeed/ops/aio.  Buffers are numpy arrays (host memory); jax device
+arrays cross through numpy views — on TPU-VM the host path is the only DMA
+route anyway (no GDS analogue, SURVEY §2.9).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..ops.op_builder import AsyncIOBuilder
+
+
+class AsyncIOEngine:
+    """Thread-pooled async reads/writes of numpy buffers to files."""
+
+    def __init__(self, num_threads: int = 8, queue_depth: int = 32):
+        self._builder = AsyncIOBuilder()
+        self._lib = self._builder.load()
+        self._h = ctypes.c_void_p(self._lib.aio_create(num_threads, queue_depth))
+        self._inflight: Dict[int, np.ndarray] = {}  # keep buffers alive
+
+    def close(self):
+        if self._h:
+            self.wait_all()
+            self._lib.aio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _buf_ptr(self, arr: np.ndarray):
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("buffer must be C-contiguous")
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    def submit_write(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+        op = self._lib.aio_submit_write(
+            self._h, path.encode(), offset, arr.nbytes, self._buf_ptr(arr)
+        )
+        self._inflight[op] = arr
+        return op
+
+    def submit_read(self, path: str, arr: np.ndarray, offset: int = 0) -> int:
+        op = self._lib.aio_submit_read(
+            self._h, path.encode(), offset, arr.nbytes, self._buf_ptr(arr)
+        )
+        self._inflight[op] = arr
+        return op
+
+    def poll(self, op: int) -> int:
+        return self._lib.aio_poll(self._h, op)
+
+    def wait(self, op: int) -> None:
+        rc = self._lib.aio_wait(self._h, op)
+        self._inflight.pop(op, None)
+        if rc != 1:
+            raise IOError(f"aio op {op} failed (rc={rc})")
+
+    def wait_all(self) -> None:
+        rc = self._lib.aio_wait_all(self._h)
+        self._inflight.clear()
+        if rc != 1:
+            raise IOError(f"aio wait_all failed (rc={rc})")
+
+    # synchronous conveniences
+    def read(self, path: str, dtype, shape) -> np.ndarray:
+        arr = np.empty(shape, dtype)
+        self.wait(self.submit_read(path, arr))
+        return arr
+
+    def write(self, path: str, arr: np.ndarray) -> None:
+        self.wait(self.submit_write(path, np.ascontiguousarray(arr)))

@@ -1,0 +1,64 @@
+"""ds_io-style NVMe benchmark CLI.
+
+reference: bin/ds_io -> deepspeed/nvme/ perf sweep.  Usage:
+
+    python -m deepspeed_tpu.nvme.bench --dir /tmp/dsio --size-mb 256 \
+        --threads 8 --ops 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+from .aio import AsyncIOEngine
+
+
+def run_bench(path_dir: str, size_mb: int, threads: int, ops: int) -> dict:
+    os.makedirs(path_dir, exist_ok=True)
+    chunk = size_mb * 1024 * 1024 // ops
+    eng = AsyncIOEngine(num_threads=threads)
+    bufs = [np.random.randint(0, 255, chunk, np.uint8) for _ in range(ops)]
+    paths = [os.path.join(path_dir, f"bench_{i}.bin") for i in range(ops)]
+
+    t0 = time.perf_counter()
+    for p, b in zip(paths, bufs):
+        eng.submit_write(p, b)
+    eng.wait_all()
+    w_dt = time.perf_counter() - t0
+
+    reads = [np.empty(chunk, np.uint8) for _ in range(ops)]
+    t0 = time.perf_counter()
+    for p, b in zip(paths, reads):
+        eng.submit_read(p, b)
+    eng.wait_all()
+    r_dt = time.perf_counter() - t0
+
+    for p in paths:
+        os.unlink(p)
+    eng.close()
+    total_gb = size_mb / 1024
+    return {
+        "write_GBps": round(total_gb / w_dt, 3),
+        "read_GBps": round(total_gb / r_dt, 3),
+        "size_mb": size_mb,
+        "threads": threads,
+        "ops": ops,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description="async-IO throughput benchmark")
+    ap.add_argument("--dir", default="/tmp/ds_tpu_io")
+    ap.add_argument("--size-mb", type=int, default=256)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--ops", type=int, default=16)
+    args = ap.parse_args()
+    print(json.dumps(run_bench(args.dir, args.size_mb, args.threads, args.ops)))
+
+
+if __name__ == "__main__":
+    main()

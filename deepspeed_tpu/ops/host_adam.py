@@ -1,0 +1,74 @@
+"""Host (CPU) fused AdamW/Lion for offloaded optimizer states.
+
+reference: deepspeed/ops/adam DeepSpeedCPUAdam (backed by csrc/adam/
+cpu_adam.cpp AVX kernels).  Operates in-place on numpy fp32 arrays that
+live in host memory — the ZeRO-Offload update path that never touches HBM.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import HostAdamBuilder
+
+
+class HostAdamW:
+    """In-place AdamW on host arrays (one instance per param group)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0):
+        self.lr, self.betas, self.eps, self.wd = lr, betas, eps, weight_decay
+        self.step_count = 0
+        self._lib = HostAdamBuilder().load()
+
+    @staticmethod
+    def is_compatible() -> bool:
+        return HostAdamBuilder().is_compatible()
+
+    def step(self, param: np.ndarray, grad: np.ndarray, m: np.ndarray, v: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        """One fused update; param/m/v fp32 modified in place; grad fp32 or
+        bfloat16-as-uint16."""
+        assert param.dtype == np.float32 and m.dtype == np.float32 and v.dtype == np.float32
+        for a in (param, grad, m, v):
+            if not a.flags["C_CONTIGUOUS"]:
+                raise ValueError("host adam buffers must be contiguous")
+        self.step_count += 1
+        f32p = ctypes.POINTER(ctypes.c_float)
+        n = param.size
+        lr = self.lr if lr is None else lr
+        if grad.dtype == np.float32:
+            self._lib.host_adamw_fp32(
+                param.ctypes.data_as(f32p), grad.ctypes.data_as(f32p),
+                m.ctypes.data_as(f32p), v.ctypes.data_as(f32p), n,
+                lr, self.betas[0], self.betas[1], self.eps, self.wd,
+                self.step_count,
+            )
+        elif grad.dtype == np.uint16:  # bf16 bits
+            self._lib.host_adamw_bf16grad(
+                param.ctypes.data_as(f32p),
+                grad.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                m.ctypes.data_as(f32p), v.ctypes.data_as(f32p), n,
+                lr, self.betas[0], self.betas[1], self.eps, self.wd,
+                self.step_count,
+            )
+        else:
+            raise TypeError(f"unsupported grad dtype {grad.dtype}")
+
+
+class HostLion:
+    """In-place Lion on host arrays (reference: ops/lion cpu path)."""
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.lr, self.betas, self.wd = lr, betas, weight_decay
+        self._lib = HostAdamBuilder().load()
+
+    def step(self, param: np.ndarray, grad: np.ndarray, m: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        f32p = ctypes.POINTER(ctypes.c_float)
+        self._lib.host_lion_fp32(
+            param.ctypes.data_as(f32p), grad.ctypes.data_as(f32p),
+            m.ctypes.data_as(f32p), param.size,
+            self.lr if lr is None else lr, self.betas[0], self.betas[1], self.wd,
+        )

@@ -1,0 +1,153 @@
+"""Native C++ ops: build, async I/O round trips, host Adam vs optax
+(the reference's kernel-vs-baseline pattern, tests/unit/ops/adam/)."""
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.op_builder import (
+    ALL_OPS,
+    AsyncIOBuilder,
+    HostAdamBuilder,
+    op_report,
+)
+
+needs_gcc = pytest.mark.skipif(
+    not AsyncIOBuilder().is_compatible(), reason="no g++ toolchain"
+)
+
+
+def test_op_report_shape():
+    rep = op_report()
+    assert set(rep) == set(ALL_OPS)
+    for info in rep.values():
+        assert "compatible" in info and "built" in info
+
+
+@needs_gcc
+def test_aio_write_read_roundtrip(tmp_path):
+    from deepspeed_tpu.nvme.aio import AsyncIOEngine
+
+    eng = AsyncIOEngine(num_threads=4)
+    data = np.random.randint(0, 255, 1 << 20, np.uint8)
+    p = str(tmp_path / "x.bin")
+    eng.write(p, data)
+    back = eng.read(p, np.uint8, data.shape)
+    np.testing.assert_array_equal(data, back)
+    eng.close()
+
+
+@needs_gcc
+def test_aio_async_many_ops(tmp_path):
+    from deepspeed_tpu.nvme.aio import AsyncIOEngine
+
+    eng = AsyncIOEngine(num_threads=8)
+    bufs = [np.full(1 << 16, i, np.uint8) for i in range(16)]
+    ops = [eng.submit_write(str(tmp_path / f"f{i}.bin"), b) for i, b in enumerate(bufs)]
+    eng.wait_all()
+    reads = [np.empty(1 << 16, np.uint8) for _ in range(16)]
+    for i, b in enumerate(reads):
+        eng.submit_read(str(tmp_path / f"f{i}.bin"), b)
+    eng.wait_all()
+    for i, b in enumerate(reads):
+        assert (b == i).all()
+    eng.close()
+
+
+@needs_gcc
+def test_aio_missing_file_errors(tmp_path):
+    from deepspeed_tpu.nvme.aio import AsyncIOEngine
+
+    eng = AsyncIOEngine(num_threads=1)
+    buf = np.empty(128, np.uint8)
+    op = eng.submit_read(str(tmp_path / "nope.bin"), buf)
+    with pytest.raises(IOError):
+        eng.wait(op)
+    eng.close()
+
+
+@needs_gcc
+def test_tensor_swapper_roundtrip(tmp_path):
+    from deepspeed_tpu.nvme.swap import TensorSwapper
+
+    sw = TensorSwapper(str(tmp_path / "swap"))
+    a = np.random.normal(size=(128, 64)).astype(np.float32)
+    b = np.random.normal(size=(32,)).astype(np.float32)
+    sw.swap_out("layer0", a)
+    sw.swap_out("layer1", b, blocking=True)
+    sw.prefetch("layer0")
+    np.testing.assert_array_equal(sw.swap_in("layer0"), a)
+    np.testing.assert_array_equal(sw.swap_in("layer1"), b)
+    sw.release("layer0")
+    with pytest.raises(KeyError):
+        sw.swap_in("layer0")
+    sw.close()
+
+
+@needs_gcc
+def test_host_adamw_matches_optax():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepspeed_tpu.ops.host_adam import HostAdamW
+
+    rng = np.random.default_rng(0)
+    n = 4097  # odd size: exercises vector tail
+    p0 = rng.normal(size=n).astype(np.float32)
+    lr, wd = 1e-2, 0.01
+
+    # optax reference
+    opt = optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+    p_ref = jnp.asarray(p0)
+    state = opt.init(p_ref)
+    grads = [rng.normal(size=n).astype(np.float32) for _ in range(5)]
+    for g in grads:
+        upd, state = opt.update(jnp.asarray(g), state, p_ref)
+        p_ref = optax.apply_updates(p_ref, upd)
+
+    # host kernel
+    ha = HostAdamW(lr=lr, weight_decay=wd)
+    p = p0.copy()
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    for g in grads:
+        ha.step(p, g, m, v)
+    np.testing.assert_allclose(p, np.asarray(p_ref), atol=1e-5, rtol=1e-5)
+
+
+@needs_gcc
+def test_host_adamw_bf16_grads():
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.host_adam import HostAdamW
+
+    rng = np.random.default_rng(1)
+    n = 513
+    p = rng.normal(size=n).astype(np.float32)
+    g32 = rng.normal(size=n).astype(np.float32)
+    g_bf16 = np.asarray(jnp.asarray(g32, jnp.bfloat16)).view(np.uint16)
+    p2 = p.copy()
+    m1, v1 = np.zeros(n, np.float32), np.zeros(n, np.float32)
+    m2, v2 = np.zeros(n, np.float32), np.zeros(n, np.float32)
+    ha1, ha2 = HostAdamW(lr=1e-2), HostAdamW(lr=1e-2)
+    ha1.step(p, g32, m1, v1)
+    ha2.step(p2, g_bf16, m2, v2)
+    # bf16 grads lose ~8 mantissa bits: loose tolerance
+    np.testing.assert_allclose(p, p2, atol=1e-3, rtol=1e-2)
+
+
+@needs_gcc
+def test_host_lion_runs():
+    from deepspeed_tpu.ops.host_adam import HostLion
+
+    rng = np.random.default_rng(2)
+    n = 256
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    before = p.copy()
+    HostLion(lr=1e-2).step(p, g, m)
+    assert not np.allclose(p, before)
+    # lion update magnitude is bounded by lr * (1 + wd*|p|)
+    assert np.max(np.abs(p - before)) <= 1e-2 * (1 + np.max(np.abs(before))) + 1e-6
