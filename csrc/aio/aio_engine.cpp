@@ -18,6 +18,7 @@
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <unistd.h>
@@ -26,6 +27,8 @@
 
 namespace {
 
+using Flag = std::shared_ptr<std::atomic<int>>; // 0 pending, 1 ok, -1 error
+
 struct Op {
   int64_t id;
   bool write;
@@ -33,7 +36,7 @@ struct Op {
   int64_t offset;
   int64_t size;
   char *buffer;
-  std::atomic<int> *done_flag; // 0 pending, 1 ok, -1 error
+  Flag done_flag;
 };
 
 class AioEngine {
@@ -52,13 +55,11 @@ public:
     cv_.notify_all();
     for (auto &t : workers_)
       t.join();
-    for (auto &kv : flags_)
-      delete kv.second;
   }
 
   int64_t submit(bool write, const char *path, int64_t offset, int64_t size,
                  char *buffer) {
-    auto *flag = new std::atomic<int>(0);
+    auto flag = std::make_shared<std::atomic<int>>(0);
     std::unique_lock<std::mutex> lk(mu_);
     int64_t id = next_id_++;
     flags_[id] = flag;
@@ -78,7 +79,7 @@ public:
   }
 
   int wait(int64_t id) {
-    std::atomic<int> *flag;
+    Flag flag; // shared ownership: safe even if another waiter reclaims the id
     {
       std::lock_guard<std::mutex> lk(mu_);
       auto it = flags_.find(id);
@@ -87,15 +88,15 @@ public:
       flag = it->second;
     }
     int v;
-    std::unique_lock<std::mutex> lk(done_mu_);
-    done_cv_.wait(lk, [&] { return (v = flag->load()) != 0; });
-    // reclaim the flag entry
+    {
+      std::unique_lock<std::mutex> lk(done_mu_);
+      done_cv_.wait(lk, [&] { return (v = flag->load()) != 0; });
+    }
+    // reclaim the flag entry; only the waiter that still finds it erases
     std::lock_guard<std::mutex> lk2(mu_);
     auto it = flags_.find(id);
-    if (it != flags_.end()) {
-      delete it->second;
+    if (it != flags_.end() && it->second == flag)
       flags_.erase(it);
-    }
     return v;
   }
 
@@ -109,7 +110,9 @@ public:
     }
     for (int64_t id : ids) {
       int v = wait(id);
-      if (v < 0)
+      // -2 here means a concurrent waiter already reclaimed the id after
+      // completion — not an I/O failure
+      if (v < 0 && v != -2)
         rc = v;
     }
     return rc;
@@ -133,7 +136,12 @@ private:
         queue_.pop_front();
       }
       int rc = run(op);
-      op.done_flag->store(rc);
+      {
+        // Publish under done_mu_ so a waiter that just evaluated the
+        // predicate cannot miss the notification between check and block.
+        std::lock_guard<std::mutex> lk(done_mu_);
+        op.done_flag->store(rc);
+      }
       done_cv_.notify_all();
     }
   }
@@ -165,7 +173,7 @@ private:
   bool stop_;
   int64_t next_id_;
   std::deque<Op> queue_;
-  std::unordered_map<int64_t, std::atomic<int> *> flags_;
+  std::unordered_map<int64_t, Flag> flags_;
   std::mutex mu_, done_mu_;
   std::condition_variable cv_, done_cv_;
   std::vector<std::thread> workers_;

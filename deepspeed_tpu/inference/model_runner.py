@@ -137,7 +137,8 @@ def decode_step(
             write_decode_kv(new_cv[l], v[:, 0], block_tables, seq_lens, active)
         )
         attn = paged_attention_decode(
-            q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1
+            q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
+            logits_soft_cap=cfg.logits_soft_cap,
         )
         attn = attn.reshape(b, 1, -1) @ lw["attn"]["wo"]
         x = x + attn.astype(x.dtype)

@@ -63,12 +63,14 @@ def write_decode_kv(cache_layer, kv, block_table, positions, active):
 
 def paged_attention_decode(
     q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
+    logits_soft_cap=None,
 ):
     """Single-token attention against paged KV.
 
     q [B, hq, hd]; cache_*_layer [num_blocks, bs, hkv, hd];
     block_table [B, P]; seq_lens [B] (length INCLUDING the current token).
-    Returns [B, hq, hd].
+    ``logits_soft_cap`` applies cap*tanh(logits/cap) before masking, matching
+    prefill's ``dot_product_attention`` (gemma-2 style).  Returns [B, hq, hd].
     """
     b, hq, hd = q.shape
     nb, bs, hkv, _ = cache_k_layer.shape
@@ -81,6 +83,8 @@ def paged_attention_decode(
     scale = scale if scale is not None else float(hd) ** -0.5
     logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
     mask = jnp.arange(p * bs)[None, :] < seq_lens[:, None]
     logits = jnp.where(mask[:, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)

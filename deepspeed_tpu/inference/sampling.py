@@ -28,7 +28,8 @@ def sample(logits: jnp.ndarray, params: SamplingParams, rng: jax.Array) -> jnp.n
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / params.temperature
     if params.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -params.top_k][:, None]
+        k = min(params.top_k, logits.shape[-1])  # k >= vocab => no-op filter
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if params.top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]

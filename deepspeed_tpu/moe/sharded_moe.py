@@ -5,8 +5,10 @@ Ports the *semantics* of the reference's gating functions
 topkgating``): softmax router, per-expert capacity
 ``ceil(k * tokens / experts * capacity_factor)`` with a ``min_capacity``
 floor, position-in-expert computed by masked cumulative sum, tokens beyond
-capacity dropped, load-balancing aux loss ``E * Σ_e me·ce`` (GShard eq.),
-optional random token priority (rts) and top-2 weight renormalisation.
+capacity dropped, load-balancing aux loss ``mean_e(me·ce) * E² / k`` over
+the full top-k choice mask (reference topkgating, sharded_moe.py:399-402;
+reduces to the GShard ``E * Σ_e me·ce`` for k=1), optional random token
+priority (rts) and top-2 weight renormalisation.
 
 Everything is static-shape dense math — [tokens, experts, capacity] one-hot
 dispatch/combine tensors contracted on the MXU, the canonical TPU MoE
@@ -107,12 +109,15 @@ def topk_gating(
     dispatch = combine > 0
     counts = occupancy.astype(jnp.float32)
 
-    # load-balance loss on first-choice assignments (reference: top1/topk use
-    # the primary routing fractions)
+    # load-balance loss over the full top-k mask with the reference's
+    # topkgating scaling (sharded_moe.py:399-402): mean(me*ce) * E^2 / k,
+    # where ce counts every one of a token's k choices
     me = jnp.mean(probs, axis=0)  # [E] mean router prob
-    first_mask = jax.nn.one_hot(topi[:, 0], e, dtype=jnp.float32)
-    ce = jnp.mean(first_mask, axis=0)  # [E] fraction of tokens
-    aux = e * jnp.sum(me * ce)
+    topk_mask = sum(
+        jax.nn.one_hot(topi[:, c], e, dtype=jnp.float32) for c in range(k)
+    )  # [N, E] with k ones per row
+    ce = jnp.mean(topk_mask, axis=0)  # [E] per-expert choice fraction (sums to k)
+    aux = jnp.mean(me * ce) * (e * e) / k
 
     routed = sum(jnp.sum(kp.astype(jnp.float32)) for kp in keeps)
     dropped = 1.0 - routed / jnp.maximum(jnp.sum(counts), 1.0)

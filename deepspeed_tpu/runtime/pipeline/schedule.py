@@ -168,25 +168,25 @@ class TrainSchedule(PipeSchedule):
             cmds: List[PipeInstruction] = []
             buf = mb % self.num_pipe_buffers() if mb >= 0 else 0
 
-            if self._valid_micro_batch(prev_mb):
-                prev_buf = prev_mb % self.num_pipe_buffers()
-                # exchange boundary data for the *previous* compute
-                if is_forward:
-                    if not self.is_first_stage:
-                        cmds.append(SendGrad(buffer_id=prev_buf))
-                else:
-                    if not self.is_last_stage:
-                        cmds.append(SendActivation(buffer_id=prev_buf))
-            if self._valid_micro_batch(mb):
-                if is_forward:
+            prev_buf = prev_mb % self.num_pipe_buffers()
+            if is_forward:
+                if self._valid_micro_batch(prev_mb) and not self.is_first_stage:
+                    cmds.append(SendGrad(buffer_id=prev_buf))
+                if self._valid_micro_batch(mb):
                     if self.is_first_stage:
                         cmds.append(LoadMicroBatch(buffer_id=buf))
                     else:
                         cmds.append(RecvActivation(buffer_id=buf))
                     cmds.append(ForwardPass(buffer_id=buf))
-                else:
-                    if not self.is_last_stage:
-                        cmds.append(RecvGrad(buffer_id=buf))
+            else:
+                # RecvGrad(curr) before SendActivation(prev) — the reference's
+                # pairing (schedule.py:236-263); the reverse order deadlocks a
+                # paired eager p2p executor (even stages send before receiving)
+                if self._valid_micro_batch(mb) and not self.is_last_stage:
+                    cmds.append(RecvGrad(buffer_id=buf))
+                if self._valid_micro_batch(prev_mb) and not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=prev_buf))
+                if self._valid_micro_batch(mb):
                     cmds.append(BackwardPass(buffer_id=buf))
             if step_id == total_steps - 1:
                 cmds.append(ReduceTiedGrads())
