@@ -81,6 +81,17 @@ def initialize(
     if dist_init_required:
         comm.comm.init_distributed()
 
+    if isinstance(model, str):
+        # HF checkpoint directory: import weights + config (the reference's
+        # load_state_dict-from-pretrained training init)
+        from .checkpoint.hf_import import load_hf_checkpoint
+        from .models.transformer import CausalLM
+
+        loaded, model_cfg = load_hf_checkpoint(model)
+        model = CausalLM(model_cfg)
+        if params is None:
+            params = loaded
+
     if model is not None and loss_fn is None:
         loss_fn = model.loss_fn
         if params is None:

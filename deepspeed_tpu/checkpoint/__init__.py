@@ -1,0 +1,16 @@
+"""Checkpointing: topology-free save/load (universal-by-construction),
+fp32 export, and HuggingFace safetensors import/export.
+
+reference: deepspeed/checkpoint/ (ds_to_universal.py, universal_checkpoint.py)
++ module_inject/load_checkpoint.py for the HF side.
+"""
+from .hf_import import (  # noqa: F401
+    config_from_hf,
+    export_hf_checkpoint,
+    load_hf_checkpoint,
+)
+from .saving import (  # noqa: F401
+    export_fp32_state_dict,
+    load_checkpoint,
+    save_checkpoint,
+)

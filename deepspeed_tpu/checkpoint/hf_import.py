@@ -1,0 +1,238 @@
+"""HuggingFace checkpoint import/export (safetensors) for Llama-family models.
+
+The reference loads HF weights through ``module_inject/load_checkpoint.py``
+and ``inference/v2/engine_factory.py:69 build_hf_engine`` (per-family
+parameter containers); training init goes through ``zero.Init`` +
+``load_state_dict``.  Here the mapping is declarative: HF parameter names →
+paths in the :func:`~deepspeed_tpu.models.transformer.init_params` pytree,
+with torch's ``[out, in]`` Linear layout transposed to our ``x @ W``
+``[in, out]`` kernels and per-layer tensors stacked into the leading ``L``
+dimension the scanned decoder expects.
+
+RoPE needs no permutation: both HF Llama and ``models/transformer.py:193``
+use the half-split (NeoX) rotation.
+
+Supported families: llama/llama2/llama3, mistral, qwen2 (attention bias),
+mixtral (MoE experts), gpt2-style learned-position models are *not* mapped
+here (their HF layout differs; use presets + own checkpoints).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+from ..utils.logging import log_dist
+
+Params = Any
+
+
+def config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
+    """Map an HF ``config.json`` dict to a TransformerConfig."""
+    model_type = hf.get("model_type", "llama")
+    kw: Dict[str, Any] = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        max_seq_len=hf.get("max_position_embeddings", 2048),
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        norm="rmsnorm",
+        activation="silu",
+        gated_mlp=True,
+        position="rope",
+    )
+    if model_type in ("qwen2", "qwen"):
+        kw["qkv_bias"] = True
+    if model_type == "mixtral" or hf.get("num_local_experts"):
+        kw["moe_num_experts"] = hf.get("num_local_experts", 0)
+        kw["moe_top_k"] = hf.get("num_experts_per_tok", 2)
+    return TransformerConfig(**kw)
+
+
+def _read_tensors(model_dir: str) -> Dict[str, np.ndarray]:
+    """All tensors from every ``*.safetensors`` shard in ``model_dir``."""
+    from safetensors import safe_open
+
+    files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+    out: Dict[str, np.ndarray] = {}
+    for fname in files:
+        with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+            for key in f.keys():
+                out[key] = f.get_tensor(key)
+    return out
+
+
+def _f(x: np.ndarray, dtype) -> np.ndarray:
+    if x.dtype == np.uint16:  # bf16 stored as raw bits by some writers
+        import ml_dtypes
+
+        x = x.view(ml_dtypes.bfloat16)
+    # cast host-side: a jnp round-trip would commit the full stacked leaf to
+    # one device (70B-class leaves are tens of GB) before sharding
+    return x.astype(np.dtype(dtype))
+
+
+def load_hf_checkpoint(
+    model_dir: str,
+    cfg: Optional[TransformerConfig] = None,
+    dtype=jnp.float32,
+) -> Tuple[Params, TransformerConfig]:
+    """safetensors checkpoint → (params pytree, config).
+
+    ``cfg`` overrides the config derived from ``config.json`` (must agree on
+    shapes).  Returns fp32 params by default — the engine casts to the
+    compute dtype itself.
+    """
+    with open(os.path.join(model_dir, "config.json")) as fh:
+        hf_cfg = json.load(fh)
+    if cfg is None:
+        cfg = config_from_hf(hf_cfg)
+    t = _read_tensors(model_dir)
+    L = cfg.num_layers
+
+    def take(name: str) -> np.ndarray:
+        if name not in t:
+            raise KeyError(
+                f"missing tensor {name!r} in checkpoint ({len(t)} tensors)"
+            )
+        return t.pop(name)
+
+    def stack(fmt: str, transpose: bool = True) -> np.ndarray:
+        ws = [take(fmt.format(i=i)) for i in range(L)]
+        ws = [w.T if transpose else w for w in ws]
+        return np.stack(ws)
+
+    attn = {
+        "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+    }
+    if cfg.qkv_bias:
+        attn["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False)
+        attn["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False)
+        attn["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False)
+    layers: Params = {
+        "attn": attn,
+        "attn_norm": {"scale": stack("model.layers.{i}.input_layernorm.weight", transpose=False)},
+        "mlp_norm": {"scale": stack("model.layers.{i}.post_attention_layernorm.weight", transpose=False)},
+    }
+    if cfg.moe_num_experts > 0:
+        E = cfg.moe_num_experts
+        def estack(fmt: str) -> np.ndarray:
+            return np.stack(
+                [
+                    np.stack([take(fmt.format(i=i, e=e)).T for e in range(E)])
+                    for i in range(L)
+                ]
+            )
+        layers["moe"] = {
+            "router": stack("model.layers.{i}.block_sparse_moe.gate.weight"),
+            # mixtral expert naming: w1=gate, w3=up, w2=down
+            "w_gate": estack("model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"),
+            "w_up": estack("model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"),
+            "w_down": estack("model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"),
+        }
+    else:
+        layers["mlp"] = {
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+        }
+    params: Params = {
+        "embed": {"embedding": take("model.embed_tokens.weight")},
+        "layers": layers,
+        "final_norm": {"scale": take("model.norm.weight")},
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in t:
+            params["lm_head"] = {"kernel": take("lm_head.weight").T}
+        else:  # checkpoint ties even if config didn't say so
+            cfg = cfg.replace(tie_embeddings=True)
+    t.pop("lm_head.weight", None)  # tied duplicate, if present
+    leftovers = [k for k in t if "rotary_emb" not in k]
+    if leftovers:
+        log_dist(f"hf import: {len(leftovers)} unmapped tensors, e.g. {leftovers[:4]}")
+    params = jax.tree_util.tree_map(lambda x: _f(x, dtype), params)
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    log_dist(f"hf import: loaded {n/1e6:.1f}M params from {model_dir}")
+    return params, cfg
+
+
+def export_hf_checkpoint(params: Params, cfg: TransformerConfig, out_dir: str) -> None:
+    """Reverse mapping: params pytree → HF-layout safetensors + config.json."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    t: Dict[str, np.ndarray] = {}
+
+    def put(name: str, arr, transpose: bool = False) -> None:
+        a = np.asarray(jnp.asarray(arr).astype(jnp.float32))
+        t[name] = a.T.copy() if transpose else np.ascontiguousarray(a)
+
+    put("model.embed_tokens.weight", params["embed"]["embedding"])
+    put("model.norm.weight", params["final_norm"]["scale"])
+    if "lm_head" in params:
+        put("lm_head.weight", params["lm_head"]["kernel"], transpose=True)
+    lw = params["layers"]
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}"
+        put(f"{pre}.self_attn.q_proj.weight", lw["attn"]["wq"][i], transpose=True)
+        put(f"{pre}.self_attn.k_proj.weight", lw["attn"]["wk"][i], transpose=True)
+        put(f"{pre}.self_attn.v_proj.weight", lw["attn"]["wv"][i], transpose=True)
+        put(f"{pre}.self_attn.o_proj.weight", lw["attn"]["wo"][i], transpose=True)
+        if cfg.qkv_bias:
+            put(f"{pre}.self_attn.q_proj.bias", lw["attn"]["bq"][i])
+            put(f"{pre}.self_attn.k_proj.bias", lw["attn"]["bk"][i])
+            put(f"{pre}.self_attn.v_proj.bias", lw["attn"]["bv"][i])
+        put(f"{pre}.input_layernorm.weight", lw["attn_norm"]["scale"][i])
+        put(f"{pre}.post_attention_layernorm.weight", lw["mlp_norm"]["scale"][i])
+        if cfg.moe_num_experts > 0:
+            put(f"{pre}.block_sparse_moe.gate.weight", lw["moe"]["router"][i], transpose=True)
+            for e in range(cfg.moe_num_experts):
+                put(f"{pre}.block_sparse_moe.experts.{e}.w1.weight", lw["moe"]["w_gate"][i, e], transpose=True)
+                put(f"{pre}.block_sparse_moe.experts.{e}.w3.weight", lw["moe"]["w_up"][i, e], transpose=True)
+                put(f"{pre}.block_sparse_moe.experts.{e}.w2.weight", lw["moe"]["w_down"][i, e], transpose=True)
+        else:
+            put(f"{pre}.mlp.gate_proj.weight", lw["mlp"]["w_gate"][i], transpose=True)
+            put(f"{pre}.mlp.up_proj.weight", lw["mlp"]["w_up"][i], transpose=True)
+            put(f"{pre}.mlp.down_proj.weight", lw["mlp"]["w_down"][i], transpose=True)
+    save_file(t, os.path.join(out_dir, "model.safetensors"))
+    model_type = "mixtral" if cfg.moe_num_experts > 0 else ("qwen2" if cfg.qkv_bias else "llama")
+    hf_cfg = {
+        "model_type": model_type,
+        "architectures": ["MixtralForCausalLM" if model_type == "mixtral" else "Qwen2ForCausalLM" if model_type == "qwen2" else "LlamaForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.hd,
+        "max_position_embeddings": cfg.max_seq_len,
+        "rms_norm_eps": cfg.norm_eps,
+        "rope_theta": cfg.rope_theta,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "torch_dtype": "float32",
+    }
+    if cfg.moe_num_experts > 0:
+        hf_cfg["num_local_experts"] = cfg.moe_num_experts
+        hf_cfg["num_experts_per_tok"] = cfg.moe_top_k
+    with open(os.path.join(out_dir, "config.json"), "w") as fh:
+        json.dump(hf_cfg, fh, indent=2)
+    log_dist(f"hf export: wrote {len(t)} tensors to {out_dir}")

@@ -42,6 +42,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     ckptr = ocp.PyTreeCheckpointer()
     state = jax.tree_util.tree_map(lambda x: x, engine.state)  # shallow copy
     ckptr.save(os.path.join(path, "state"), state, force=True)
+    nvme = getattr(engine, "_nvme_opt", None)
+    if nvme is not None:
+        # NVMe tier: masters + Adam moments live in the swap pool, not the
+        # TrainState — persist them alongside (test_nvme_checkpointing.py)
+        nvme.save_to(os.path.join(path, "nvme_state"))
     meta = {
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
@@ -50,8 +55,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         "zero_stage": engine.config.zero_optimization.stage,
         "dp_world_size": engine.grid.dp_world_size,
     }
-    with open(os.path.join(path, "meta.json"), "w") as fh:
-        json.dump(meta, fh)
+    if jax.process_index() == 0:
+        # rank-0 only: every process writing meta.json races on shared
+        # filesystems (the reference guards all non-sharded files this way)
+        with open(os.path.join(path, "meta.json"), "w") as fh:
+            json.dump(meta, fh)
     if jax.process_index() == 0:
         with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
             fh.write(tag)
@@ -97,6 +105,9 @@ def load_checkpoint(
     if not load_optimizer_states:
         state = state._replace(opt_state=engine.state.opt_state)
     engine.state = state
+    nvme = getattr(engine, "_nvme_opt", None)
+    if nvme is not None and load_optimizer_states:
+        nvme.restore_from(os.path.join(path, "nvme_state"))
     with open(os.path.join(path, "meta.json")) as fh:
         meta = json.load(fh)
     engine.global_steps = int(meta["global_steps"])
@@ -110,6 +121,9 @@ def load_checkpoint(
 def export_fp32_state_dict(engine):
     """``zero_to_fp32`` equivalent (reference utils/zero_to_fp32.py:533):
     gather the fp32 masters to host as one logical state dict."""
+    nvme = getattr(engine, "_nvme_opt", None)
+    if nvme is not None:
+        return nvme.export_masters()  # state.params is only the bf16 copy
     return jax.tree_util.tree_map(
         lambda x: jax.device_get(x), engine.state.params
     )

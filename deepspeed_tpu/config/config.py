@@ -63,9 +63,11 @@ class ZeroConfig:
     # ZeRO-3 persistence: params smaller than this stay replicated
     # (reference: stage3_param_persistence_threshold)
     param_persistence_threshold: int = 10_000
-    # offload targets: None | "cpu"  (host memory space)
+    # offload targets: None | "cpu" (host memory space) | "nvme" (local SSD
+    # via the C++ AIO engine; reference runtime/zero/offload_config.py)
     offload_optimizer: Optional[str] = None
     offload_param: Optional[str] = None
+    offload_nvme_path: str = "/tmp/deepspeed_tpu_nvme"
     # ZeRO++ style knobs
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
@@ -92,9 +94,16 @@ class ZeroConfig:
         for k in ("offload_optimizer", "offload_param"):
             v = getattr(self, k)
             if isinstance(v, dict):  # reference nests {"device": "cpu", ...}
+                if v.get("nvme_path"):
+                    self.offload_nvme_path = v["nvme_path"]
                 setattr(self, k, v.get("device"))
         if self.offload_optimizer not in (None, "none", "cpu", "nvme"):
             raise ConfigError(f"bad offload_optimizer {self.offload_optimizer}")
+        if self.offload_param not in (None, "none", "cpu"):
+            raise ConfigError(
+                f"bad offload_param {self.offload_param!r} (supported: cpu; "
+                "params-to-nvme has no TPU implementation yet)"
+            )
         if self.offload_optimizer == "none":
             self.offload_optimizer = None
         if self.offload_param == "none":

@@ -87,7 +87,19 @@ class InferenceEngine:
 
 
 def init_inference(model, params=None, mesh=None, seed: int = 0, **kw) -> InferenceEngine:
-    """reference: deepspeed.init_inference (deepspeed/__init__.py:291)."""
+    """reference: deepspeed.init_inference (deepspeed/__init__.py:291).
+
+    ``model`` may be a path to an HF safetensors checkpoint directory — the
+    analogue of the reference's checkpoint-loading path
+    (inference/engine.py:301 load_model_with_checkpoint).
+    """
+    if isinstance(model, str):
+        from ..checkpoint.hf_import import load_hf_checkpoint
+        from ..models.transformer import CausalLM
+
+        loaded, cfg = load_hf_checkpoint(model)
+        model = CausalLM(cfg)
+        params = loaded if params is None else params
     if params is None:
         params = model.init_params(jax.random.PRNGKey(seed))
     grid = mesh

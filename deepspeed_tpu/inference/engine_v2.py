@@ -83,6 +83,22 @@ class InferenceEngineV2:
         free = self.mgr.allocator.free_blocks
         return free * self.block_size, free
 
+    @classmethod
+    def from_hf(cls, model_dir: str, dtype=None, **kw) -> "InferenceEngineV2":
+        """Build from an HF safetensors checkpoint directory — the analogue
+        of the reference's ``build_hf_engine`` (inference/v2/engine_factory.py:69)."""
+        from ..checkpoint.hf_import import load_hf_checkpoint
+
+        params, cfg = load_hf_checkpoint(model_dir)
+        if dtype is not None:
+            cfg = cfg.replace(dtype=dtype)
+        # serve in the compute dtype (cfg.dtype defaults to bf16, matching
+        # the KV cache) — the reference's build_hf_engine casts the same way
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, cfg.dtype), params
+        )
+        return cls(params, cfg, **kw)
+
     def can_schedule(self, prompt_lens: Sequence[int]) -> bool:
         blocks = sum(-(-p // self.block_size) for p in prompt_lens)
         return (

@@ -83,5 +83,10 @@ class TensorSwapper:
             self.engine.wait(rec.pending_op)
             rec.pending_op = None
 
+    def flush(self) -> None:
+        """Block until every in-flight write has landed."""
+        for rec in self._records.values():
+            self._finish_write(rec)
+
     def close(self):
         self.engine.close()

@@ -119,20 +119,47 @@ class DeepSpeedTpuEngine:
         shapes = jax.eval_shape(lambda p: p, params)
         self.plan = zero.plan_sharding(shapes, config.zero_optimization, grid.spec, tp_rules)
         self.param_shardings = self.plan.param_shardings(self.mesh)
-        self.master_shardings = self.plan.master_shardings(self.mesh)
         self._scalar_sharding = NamedSharding(self.mesh, P())
 
-        # ---- place master params + init optimizer state, sharded at creation ----
-        place_masters = jax.jit(
-            lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p),
-            out_shardings=self.master_shardings,
+        # ---- offload tiers (reference: runtime/zero/offload_config.py) ----
+        zcfg = config.zero_optimization
+        self._offload_nvme = zcfg.offload_optimizer == "nvme"
+        self._offload_cpu = (not self._offload_nvme) and self.plan.wants_cpu_offload
+        # device-kind shardings always exist; host-kind variants overlay them
+        # when the CPU tier is on (memory_kind='pinned_host')
+        self.master_shardings_dev = self.plan.master_shardings(self.mesh)
+        self.master_shardings = self.plan.master_shardings(
+            self.mesh, allow_offload=True
         )
-        master_params = place_masters(params)
-        opt_shapes = jax.eval_shape(self.optimizer.init, master_params)
-        self.opt_shardings = self.plan.opt_state_shardings(self.mesh, opt_shapes)
-        opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)(
-            master_params
-        )
+        self._nvme_opt = None
+
+        if self._offload_nvme:
+            # NVMe tier: only bf16 compute params live on device; fp32
+            # masters + Adam moments go to local SSD (runtime/offload.py)
+            master_params, opt_state = self._init_nvme_offload(params, zcfg)
+        else:
+            # place masters sharded-at-creation via a device-kind jit (host
+            # out_shardings inside jit are TPU-only), then hop memory kinds
+            place_masters = jax.jit(
+                lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p),
+                out_shardings=self.master_shardings_dev,
+            )
+            master_params = place_masters(params)
+            opt_shapes = jax.eval_shape(self.optimizer.init, master_params)
+            self.opt_shardings_dev = self.plan.opt_state_shardings(self.mesh, opt_shapes)
+            self.opt_shardings = self.plan.opt_state_shardings(
+                self.mesh, opt_shapes, allow_offload=True
+            )
+            opt_state = jax.jit(
+                self.optimizer.init, out_shardings=self.opt_shardings_dev
+            )(master_params)
+            if self._offload_cpu:
+                master_params = jax.device_put(master_params, self.master_shardings)
+                opt_state = jax.device_put(opt_state, self.opt_shardings)
+                log_dist(
+                    "ZeRO-Offload(cpu): fp32 masters + optimizer state placed "
+                    "in pinned_host memory"
+                )
 
         fp16 = config.fp16.enabled
         loss_scale_state = precision.init_loss_scale(
@@ -274,7 +301,9 @@ class DeepSpeedTpuEngine:
 
             def one_micro(p, micro, r):
                 loss, grads = self._micro_value_and_grad(p, micro, r, scale)
-                grads = zero.constrain(grads, self.master_shardings)
+                # device-kind layout: grads live in HBM even when masters are
+                # offloaded (only the state pytree itself rides pinned_host)
+                grads = zero.constrain(grads, self.master_shardings_dev)
                 return loss, grads
 
             if gas == 1:
@@ -318,17 +347,185 @@ class DeepSpeedTpuEngine:
 
     def _get_train_step(self, batch):
         if self._train_step is None:
+            if self._offload_nvme:
+                self._train_step = self._make_nvme_train_step(batch)
+                return self._train_step
             step_fn = self._make_train_step()
             metrics_shardings = StepMetrics(
                 *([self._scalar_sharding] * len(StepMetrics._fields))
             )
-            self._train_step = jax.jit(
+            jitted = jax.jit(
                 step_fn,
                 in_shardings=(self.state_shardings, self.batch_sharding(batch, batch_dim=1), None),
                 out_shardings=(self.state_shardings, metrics_shardings),
                 donate_argnums=(0,),
             )
+            if self._offload_cpu:
+                jitted = self._wrap_offload_step(jitted, step_fn, batch, metrics_shardings)
+            self._train_step = jitted
         return self._train_step
+
+    def _dev_state_shardings(self):
+        """state_shardings with every leaf in device memory (no host kinds)."""
+        return self.state_shardings._replace(
+            params=self.master_shardings_dev, opt_state=self.opt_shardings_dev
+        )
+
+    def _wrap_offload_step(self, jit_host, step_fn, batch, metrics_shardings):
+        """CPU-offload execution strategy.  On TPU, jit takes/returns the
+        masters + opt state directly in pinned_host memory and XLA streams
+        them through HBM (the performant ZeRO-Offload schedule).  Backends
+        that reject host-memory shardings inside jit (the CPU test mesh) fall
+        back to staging the transfers around a device-kind step."""
+        state_sh_dev = self._dev_state_shardings()
+        jit_dev = jax.jit(
+            step_fn,
+            in_shardings=(state_sh_dev, self.batch_sharding(batch, batch_dim=1), None),
+            out_shardings=(state_sh_dev, metrics_shardings),
+            donate_argnums=(0,),
+        )
+        mode = {"v": None}
+
+        def call(state, batch_, rng):
+            if mode["v"] in (None, "host"):
+                try:
+                    out = jit_host(state, batch_, rng)
+                    mode["v"] = "host"
+                    return out
+                except Exception as e:  # noqa: BLE001 — backend capability probe
+                    if mode["v"] == "host":
+                        raise
+                    log_dist(
+                        f"host-memory jit unsupported here ({type(e).__name__}); "
+                        "staging offload transfers around the device step"
+                    )
+                    mode["v"] = "staged"
+            dev_state = jax.device_put(state, state_sh_dev)
+            new_state, metrics = jit_dev(dev_state, batch_, rng)
+            new_state = new_state._replace(
+                params=jax.device_put(new_state.params, self.master_shardings),
+                opt_state=jax.device_put(new_state.opt_state, self.opt_shardings),
+            )
+            return new_state, metrics
+
+        return call
+
+    # ------------------------------------------------------------------
+    # NVMe offload path (reference: partitioned_optimizer_swapper.py)
+    # ------------------------------------------------------------------
+    def _init_nvme_offload(self, params, zcfg):
+        from ..config.config import ConfigError
+        from .offload import NVMeOptimizer
+
+        if self.config.fp16.enabled:
+            raise ConfigError("offload_optimizer=nvme requires bf16 (no fp16 loss scaling)")
+        if self.config.optimizer.type.lower() not in ("adam", "adamw"):
+            raise ConfigError(
+                f"offload_optimizer=nvme supports adam/adamw (host fused kernel), "
+                f"got {self.config.optimizer.type}"
+            )
+        op = self.config.optimizer.params or {}
+        self._nvme_opt = NVMeOptimizer(
+            zcfg.offload_nvme_path,
+            lr=float(op.get("lr", 1e-3)),
+            betas=tuple(op.get("betas", (0.9, 0.999))),
+            eps=float(op.get("eps", 1e-8)),
+            weight_decay=float(op.get("weight_decay", 0.0)),
+        )
+        place = jax.jit(
+            lambda p: precision.cast_floating(p, self.compute_dtype),
+            out_shardings=self.param_shardings,
+        )
+        compute_params = place(params)
+        self._nvme_opt.init(
+            jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), params)
+        )
+        # state.params holds the bf16 compute copy; masters are on disk
+        self.master_shardings = self.param_shardings
+        self.master_shardings_dev = self.param_shardings
+        self.opt_shardings = ()
+        self.opt_shardings_dev = ()
+        return compute_params, ()
+
+    def _make_nvme_train_step(self, batch):
+        cfg = self.config
+        gas = cfg.gradient_accumulation_steps
+        clip = cfg.gradient_clipping
+
+        def grad_step(params, batch_, rng):
+            def one(p, micro, r):
+                loss, grads = self._micro_value_and_grad(
+                    p, micro, r, jnp.asarray(1.0, jnp.float32)
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads
+                )
+                return loss, zero.constrain(grads, self.master_shardings_dev)
+
+            if gas == 1:
+                micro = jax.tree_util.tree_map(lambda x: x[0], batch_)
+                loss, grads = one(params, micro, rng)
+            else:
+                def body(carry, inp):
+                    acc, lsum = carry
+                    micro, r = inp
+                    loss, g = one(params, micro, r)
+                    return (jax.tree_util.tree_map(jnp.add, acc, g), lsum + loss), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params
+                )
+                (grads, lsum), _ = jax.lax.scan(
+                    body,
+                    (zeros, jnp.asarray(0.0, jnp.float32)),
+                    (batch_, jax.random.split(rng, gas)),
+                )
+                loss = lsum / gas
+                grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+            return loss, grads, precision.global_grad_norm(grads)
+
+        jit_grad = jax.jit(
+            grad_step,
+            in_shardings=(
+                self.param_shardings,
+                self.batch_sharding(batch, batch_dim=1),
+                None,
+            ),
+            out_shardings=(
+                self._scalar_sharding,
+                self.master_shardings_dev,
+                self._scalar_sharding,
+            ),
+        )
+        upload = jax.jit(
+            lambda m: precision.cast_floating(m, self.compute_dtype),
+            out_shardings=self.param_shardings,
+        )
+
+        def call(state: TrainState, batch_, rng):
+            loss, grads, gnorm = jit_grad(state.params, batch_, rng)
+            gn = float(gnorm)
+            coef = min(1.0, clip / (gn + 1e-6)) if clip and clip > 0 else 1.0
+            lr = float(self.lr_schedule_fn(state.step))
+            step_num = int(state.step) + 1
+            grads_host = jax.tree_util.tree_map(np.asarray, grads)
+            masters = self._nvme_opt.step(grads_host, lr, step_num, coef)
+            new_state = TrainState(
+                step=state.step + 1,
+                params=upload(masters),
+                opt_state=state.opt_state,
+                loss_scale=state.loss_scale,
+            )
+            metrics = StepMetrics(
+                loss=loss,
+                grad_norm=gnorm,
+                lr=jnp.asarray(lr, jnp.float32),
+                loss_scale=jnp.asarray(1.0, jnp.float32),
+                skipped=jnp.asarray(False),
+            )
+            return new_state, metrics
+
+        return call
 
     # ------------------------------------------------------------------
     # public API — fused path
@@ -365,7 +562,12 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     def forward(self, batch):
         """Stage a micro-batch; returns its loss (reference engine.py:1926)."""
+        if self._offload_nvme:
+            raise NotImplementedError(
+                "offload_optimizer=nvme supports the fused train_batch() path only"
+            )
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        state_sh = self._dev_state_shardings() if self._offload_cpu else self.state_shardings
         if self._grad_fn is None:
             def micro_step(state, micro, rng):
                 scale = (
@@ -374,15 +576,16 @@ class DeepSpeedTpuEngine:
                     else jnp.asarray(1.0, jnp.float32)
                 )
                 loss, grads = self._micro_value_and_grad(state.params, micro, rng, scale)
-                grads = zero.constrain(grads, self.master_shardings)
+                grads = zero.constrain(grads, self.master_shardings_dev)
                 return loss, grads
 
             self._grad_fn = jax.jit(
                 micro_step,
-                in_shardings=(self.state_shardings, self.batch_sharding(batch), None),
-                out_shardings=(self._scalar_sharding, self.master_shardings),
+                in_shardings=(state_sh, self.batch_sharding(batch), None),
+                out_shardings=(self._scalar_sharding, self.master_shardings_dev),
             )
-        loss, grads = self._grad_fn(self.state, batch, self._next_rng())
+        st = jax.device_put(self.state, state_sh) if self._offload_cpu else self.state
+        loss, grads = self._grad_fn(st, batch, self._next_rng())
         self._pending = {"grads": grads, "loss": loss}
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -410,6 +613,7 @@ class DeepSpeedTpuEngine:
         """Apply accumulated gradients at the GAS boundary (engine.py:2282)."""
         if not self.is_gradient_accumulation_boundary():
             return
+        state_sh = self._dev_state_shardings() if self._offload_cpu else self.state_shardings
         if self._apply_fn is None:
             fp16 = self.config.fp16.enabled
             gas = self.config.gradient_accumulation_steps
@@ -421,11 +625,18 @@ class DeepSpeedTpuEngine:
 
             self._apply_fn = jax.jit(
                 apply,
-                in_shardings=(self.state_shardings, self.master_shardings),
-                out_shardings=(self.state_shardings, self._scalar_sharding),
+                in_shardings=(state_sh, self.master_shardings_dev),
+                out_shardings=(state_sh, self._scalar_sharding),
                 donate_argnums=(0, 1),
             )
-        self.state, skipped = self._apply_fn(self.state, self._grad_buffer)
+        st = jax.device_put(self.state, state_sh) if self._offload_cpu else self.state
+        new_state, skipped = self._apply_fn(st, self._grad_buffer)
+        if self._offload_cpu:
+            new_state = new_state._replace(
+                params=jax.device_put(new_state.params, self.master_shardings),
+                opt_state=jax.device_put(new_state.opt_state, self.opt_shardings),
+            )
+        self.state = new_state
         self._grad_buffer = None
         self.global_steps += 1
         if bool(skipped):
@@ -447,7 +658,12 @@ class DeepSpeedTpuEngine:
                 return fn(cp, b, rng)
 
             self._eval_step = jax.jit(ev)
-        return self._eval_step(self.state, batch, self._next_rng())
+        st = (
+            jax.device_put(self.state, self._dev_state_shardings())
+            if self._offload_cpu
+            else self.state
+        )
+        return self._eval_step(st, batch, self._next_rng())
 
     # ------------------------------------------------------------------
     # misc parity API

@@ -1,0 +1,145 @@
+"""ZeRO-Offload end-to-end: CPU (pinned_host) and NVMe (swap + host Adam).
+
+Mirrors the reference's offload coverage (``tests/unit/runtime/zero/
+test_zero.py`` offload combos + ``test_nvme_checkpointing.py``): training
+must actually run with the offload tier engaged, state must live where the
+config says, and numerics must match the non-offloaded baseline.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from simple_model import init_mlp, mlp_loss, random_batches
+
+CFG = {
+    "train_micro_batch_size_per_gpu": 2,
+    "gradient_accumulation_steps": 1,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "bf16": {"enabled": False},
+    "steps_per_print": 100,
+}
+
+
+def _engine(zero_extra, gas=1):
+    cfg = {**CFG, "gradient_accumulation_steps": gas}
+    cfg["zero_optimization"] = {"stage": 1, **zero_extra}
+    params = init_mlp(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss,
+        params=params,
+        config=cfg,
+        mesh=deepspeed_tpu.initialize_mesh(fsdp=8),
+    )
+    return engine
+
+
+def _train(engine, steps=5, gas=1):
+    micro = gas and engine.config.train_micro_batch_size_per_gpu * engine.dp_world_size
+    return [float(engine.train_batch(b)) for b in random_batches(steps, gas, micro)]
+
+
+def _leaf_memkinds(tree):
+    return {
+        getattr(l.sharding, "memory_kind", None)
+        for l in jax.tree_util.tree_leaves(tree)
+    }
+
+
+def test_cpu_offload_state_lives_on_host():
+    engine = _engine({"offload_optimizer": {"device": "cpu"}})
+    assert engine._offload_cpu
+    assert _leaf_memkinds(engine.state.params) == {"pinned_host"}
+    assert "pinned_host" in _leaf_memkinds(engine.state.opt_state)
+    losses = _train(engine, steps=6)
+    assert losses[-1] < losses[0]
+    # state stays on host across steps
+    assert _leaf_memkinds(engine.state.params) == {"pinned_host"}
+
+
+def test_cpu_offload_parity_with_baseline():
+    ref = _train(_engine({}), steps=4)
+    got = _train(_engine({"offload_optimizer": "cpu"}), steps=4)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_cpu_offload_gas_and_shim():
+    engine = _engine({"offload_optimizer": "cpu"}, gas=2)
+    losses = _train(engine, steps=4, gas=2)
+    assert losses[-1] < losses[0]
+    # forward/backward/step shim works under offload too
+    batch = {
+        "x": np.random.RandomState(0).randn(16, 8).astype(np.float32),
+        "y": np.zeros((16, 8), np.float32),
+    }
+    engine.forward(batch)
+    engine.backward()
+    engine.forward(batch)
+    engine.backward()
+    engine.step()
+    assert _leaf_memkinds(engine.state.params) == {"pinned_host"}
+
+
+def test_nvme_offload_trains(tmp_path):
+    engine = _engine(
+        {
+            "offload_optimizer": {
+                "device": "nvme",
+                "nvme_path": str(tmp_path / "swap"),
+            }
+        }
+    )
+    assert engine._offload_nvme
+    # optimizer state is on disk, not in the train state
+    assert engine.state.opt_state == ()
+    assert os.listdir(str(tmp_path / "swap"))
+    losses = _train(engine, steps=6)
+    assert losses[-1] < losses[0]
+
+
+def test_nvme_offload_parity_with_baseline(tmp_path):
+    """Host fused AdamW on swapped state must track optax.adamw on device."""
+    ref = _train(_engine({}), steps=4)
+    got = _train(
+        _engine({"offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path / "s")}}),
+        steps=4,
+    )
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-5)
+
+
+def test_nvme_checkpoint_round_trip(tmp_path):
+    """reference: tests/unit/runtime/zero/test_nvme_checkpointing.py —
+    masters + moments must survive save/load, and a restored run must
+    continue exactly like the uninterrupted one."""
+    swap_a = {"device": "nvme", "nvme_path": str(tmp_path / "a")}
+    batches = random_batches(6, 1, 16)
+    eng = _engine({"offload_optimizer": swap_a})
+    for b in batches[:3]:
+        eng.train_batch(b)
+    ckpt = str(tmp_path / "ckpt")
+    eng.save_checkpoint(ckpt)
+    tail_ref = [float(eng.train_batch(b)) for b in batches[3:]]
+
+    eng2 = _engine(
+        {"offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path / "b")}}
+    )
+    eng2.load_checkpoint(ckpt)
+    tail_got = [float(eng2.train_batch(b)) for b in batches[3:]]
+    np.testing.assert_allclose(tail_got, tail_ref, rtol=1e-5, atol=1e-6)
+
+    # fp32 export pulls the masters, not the bf16 compute copy
+    from deepspeed_tpu.checkpoint.saving import export_fp32_state_dict
+
+    sd = export_fp32_state_dict(eng2)
+    assert all(l.dtype == np.float32 for l in jax.tree_util.tree_leaves(sd))
+
+
+def test_nvme_offload_gas(tmp_path):
+    engine = _engine(
+        {"offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path / "s")}},
+        gas=2,
+    )
+    losses = _train(engine, steps=4, gas=2)
+    assert losses[-1] < losses[0]
