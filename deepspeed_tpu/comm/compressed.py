@@ -1,0 +1,87 @@
+"""Error-feedback 1-bit compressed allreduce (the 1-bit Adam/LAMB transport).
+
+Ports the semantics of the reference's compressed backends
+(``runtime/comm/nccl.py:51 NcclBackend.compressed_allreduce`` — sign
+compression with worker/server error feedback and a two-phase
+gather/allgather exchange; generic ``runtime/comm/compressed.py:13``).
+
+TPU formulation: runs *inside* ``shard_map`` over the data-parallel axes.
+Phase 1 chunks the flattened tensor into ``W`` pieces and ``all_to_all``s
+int8 signs + per-chunk fp32 scales (each rank becomes the "server" for its
+chunk); phase 2 re-compresses the locally reduced chunk (server error
+feedback) and ``all_gather``s it back.  Payload on the wire is int8 — 2×
+smaller than bf16 and 4× smaller than fp32 gradients; scales are one fp32
+per chunk.  (The reference packs to literal bits via cupy packbits; int8 is
+the TPU-collective-friendly equivalent and keeps the same error-feedback
+convergence behaviour, which is what the algorithm needs.)
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def _axis_size(axis_name: AxisNames) -> int:
+    if isinstance(axis_name, str):
+        return jax.lax.axis_size(axis_name)
+    size = 1
+    for ax in axis_name:
+        size *= jax.lax.axis_size(ax)
+    return size
+
+
+def _compress(buf: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """sign/scale compression per leading-dim chunk; returns
+    (int8 signs, fp32 scales [chunks], error)."""
+    scale = jnp.mean(jnp.abs(buf), axis=-1)  # [chunks] — 1-bit Adam's l1 scaling
+    signs = jnp.where(buf >= 0, 1, -1).astype(jnp.int8)
+    decompressed = signs.astype(jnp.float32) * scale[..., None]
+    return signs, scale, buf - decompressed
+
+
+def compressed_allreduce(
+    x: jnp.ndarray,
+    worker_error: jnp.ndarray,
+    server_error: jnp.ndarray,
+    axis_name: AxisNames,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Mean-allreduce ``x`` with sign compression + error feedback.
+
+    Must be called inside ``shard_map``; ``worker_error``/``server_error``
+    are this rank's persistent error buffers (flat, sizes ``padded`` and
+    ``padded // W``).  Returns (mean, new_worker_error, new_server_error).
+    """
+    w = _axis_size(axis_name)
+    n = x.size
+    padded = worker_error.size
+    chunk = padded // w
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, padded - n))
+
+    # phase 1: compress locally, all_to_all so rank r holds chunk r of all ranks
+    buf = (flat + worker_error).reshape(w, chunk)
+    signs, scales, err = _compress(buf)
+    new_worker_error = err.reshape(-1)
+    recv_signs = jax.lax.all_to_all(signs, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_scales = jax.lax.all_to_all(scales[:, None], axis_name, split_axis=0, concat_axis=0, tiled=False)
+    # recv_signs [W, 1?, chunk] layout: leading axis = source rank
+    recv = recv_signs.astype(jnp.float32).reshape(w, chunk) * recv_scales.reshape(w, 1)
+    my_chunk_avg = jnp.mean(recv, axis=0)  # [chunk] — server-side reduce
+
+    # phase 2: compress the reduced chunk, all_gather to every rank
+    buf2 = (my_chunk_avg + server_error)[None, :]
+    signs2, scales2, err2 = _compress(buf2)
+    new_server_error = err2.reshape(-1)
+    all_signs = jax.lax.all_gather(signs2.reshape(chunk), axis_name)  # [W, chunk]
+    all_scales = jax.lax.all_gather(scales2.reshape(()), axis_name)  # [W]
+    full = all_signs.astype(jnp.float32) * all_scales[:, None]
+    return full.reshape(-1)[:n].reshape(x.shape), new_worker_error, new_server_error
+
+
+def error_buffer_sizes(n: int, world: int) -> Tuple[int, int]:
+    """(worker, server) flat error-buffer sizes for an n-element tensor."""
+    padded = -(-n // world) * world
+    return padded, padded // world

@@ -52,7 +52,15 @@ def build_optimizer(
     betas = params.get("betas", (0.9, 0.999))
     eps = params.get("eps", 1e-8)
 
-    if name in (ADAM, FUSED_ADAM, CPU_ADAM, ONEBIT_ADAM, ZERO_ONE_ADAM):
+    if name in (ONEBIT_ADAM, ZERO_ONE_ADAM, ONEBIT_LAMB):
+        # no silent dense fallback: the compressed-communication step lives in
+        # runtime/onebit.py and only the engine can run it (it owns the
+        # shard_map over the DP axes)
+        raise ValueError(
+            f"{type_name} is engine-managed: pass it as config optimizer.type "
+            "to deepspeed_tpu.initialize(); it has no standalone optax form"
+        )
+    if name in (ADAM, FUSED_ADAM, CPU_ADAM):
         if params.get("adam_w_mode", True) and name == ADAM:
             # reference FusedAdam defaults to adam_w_mode=True (ops/adam)
             return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
@@ -65,7 +73,7 @@ def build_optimizer(
         return optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
     if name == ADAMW:
         return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
-    if name in (LAMB, FUSED_LAMB, ONEBIT_LAMB):
+    if name in (LAMB, FUSED_LAMB):
         return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
     if name in (LION, FUSED_LION):
         b = params.get("betas", (0.9, 0.99))

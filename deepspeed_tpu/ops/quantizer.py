@@ -1,0 +1,87 @@
+"""Quantization ops: symmetric int8 and fp8 with per-group scales.
+
+Public API over the Pallas kernels (``ops/pallas/quant_kernel.py``) with a
+jnp reference path for odd shapes / CPU; the counterpart of the reference's
+``deepspeed/ops/quantizer`` + ``ops/fp_quantizer`` front-ends over
+``csrc/quantization`` and ``csrc/fp_quantizer``.
+
+All functions operate on arbitrary-shape arrays; quantization groups are
+rows of the ``[-1, group_size]`` flattening (group_size defaults to the
+trailing dimension), matching the reference's contiguous-group scheme
+(quantize.cu processes ``elems_per_group`` runs).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .pallas import quant_kernel
+
+
+class QuantizedTensor(NamedTuple):
+    data: jnp.ndarray  # int8 or fp8, original shape
+    scales: jnp.ndarray  # fp32 [groups]
+    group_size: int
+    orig_dtype: jnp.dtype
+
+
+def _grouped(x: jnp.ndarray, group_size: Optional[int]) -> Tuple[jnp.ndarray, int]:
+    n = x.size
+    gs = group_size or (x.shape[-1] if x.ndim else n)
+    if n % gs:
+        gs = n  # degenerate: one group
+    return x.reshape(n // gs, gs), gs
+
+
+def _use_pallas(x2d) -> bool:
+    return (
+        jax.default_backend() == "tpu" and quant_kernel.supports(x2d)
+    ) or quant_kernel._INTERPRET
+
+
+def quantize_int8(x: jnp.ndarray, group_size: Optional[int] = None) -> QuantizedTensor:
+    """Symmetric int8: q = round(x / s), s = amax/127 per group."""
+    orig_dtype = x.dtype
+    x2d, gs = _grouped(x, group_size)
+    if _use_pallas(x2d):
+        q, s = quant_kernel.quantize_int8(x2d)
+    else:
+        xf = x2d.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        s = (jnp.maximum(amax, 1e-12) / 127.0)[..., 0]
+        q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q.reshape(x.shape), s, gs, orig_dtype)
+
+
+def dequantize(qt: QuantizedTensor, dtype=None) -> jnp.ndarray:
+    dtype = dtype or qt.orig_dtype
+    q2d = qt.data.reshape(-1, qt.group_size)
+    if qt.data.dtype == jnp.int8 and _use_pallas(q2d):
+        out = quant_kernel.dequantize_int8(q2d, qt.scales, out_dtype=dtype)
+    else:
+        out = (q2d.astype(jnp.float32) * qt.scales[..., None]).astype(dtype)
+    return out.reshape(qt.data.shape)
+
+
+def quantize_fp8(
+    x: jnp.ndarray, dtype=jnp.float8_e4m3fn, group_size: Optional[int] = None
+) -> QuantizedTensor:
+    """Scaled fp8 cast (e4m3 default; e5m2 for gradients à la fp_quantizer)."""
+    orig_dtype = x.dtype
+    x2d, gs = _grouped(x, group_size)
+    if _use_pallas(x2d):
+        q, s = quant_kernel.quantize_fp8(x2d, dtype=dtype)
+    else:
+        xf = x2d.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        s = (jnp.maximum(amax, 1e-12) / float(jnp.finfo(dtype).max))[..., 0]
+        q = (xf / s[..., None]).astype(dtype)
+    return QuantizedTensor(q.reshape(x.shape), s, gs, orig_dtype)
+
+
+def fake_quantize_int8(x: jnp.ndarray, group_size: Optional[int] = None) -> jnp.ndarray:
+    """quantize→dequantize in one call (the reference's fake_quantizer.cu,
+    used by compression's QAT path)."""
+    return dequantize(quantize_int8(x, group_size))

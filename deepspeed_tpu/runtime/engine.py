@@ -109,9 +109,20 @@ class DeepSpeedTpuEngine:
         self.monitor = None  # attached by initialize()
         self.lr_schedule_fn = self._build_lr_schedule()
         self.lr_scheduler = LRScheduler(self.lr_schedule_fn)
-        self.optimizer = build_optimizer(
-            config.optimizer.type, config.optimizer.params, learning_rate=self.lr_schedule_fn
+        self._onebit = config.optimizer.type.lower().replace("_", "") in (
+            "onebitadam",
+            "zerooneadam",
+            "onebitlamb",
         )
+        if self._onebit:
+            from . import onebit
+
+            onebit.check_supported(config)
+            self.optimizer = None  # the compressed step owns the update math
+        else:
+            self.optimizer = build_optimizer(
+                config.optimizer.type, config.optimizer.params, learning_rate=self.lr_schedule_fn
+            )
         self.compute_dtype = precision.compute_dtype(config.precision_dtype)
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
 
@@ -121,8 +132,30 @@ class DeepSpeedTpuEngine:
         self.param_shardings = self.plan.param_shardings(self.mesh)
         self._scalar_sharding = NamedSharding(self.mesh, P())
 
-        # ---- offload tiers (reference: runtime/zero/offload_config.py) ----
+        # ---- ZeRO++ quantized collectives (runtime/zeropp.py) ----
         zcfg = config.zero_optimization
+        self._zeropp_vag = None
+        if (
+            zcfg.stage >= 3
+            and (zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients)
+            and grid.spec.fsdp > 1
+        ):
+            from . import zeropp
+
+            self._zeropp_vag = zeropp.make_micro_value_and_grad(
+                self.loss_fn,
+                self.mesh,
+                self.plan.master_specs,
+                self.compute_dtype,
+                zcfg.zero_quantized_weights,
+                zcfg.zero_quantized_gradients,
+            )
+            log_dist(
+                f"ZeRO++ enabled: qwZ={zcfg.zero_quantized_weights} "
+                f"qgZ={zcfg.zero_quantized_gradients} (int8 collectives on fsdp)"
+            )
+
+        # ---- offload tiers (reference: runtime/zero/offload_config.py) ----
         self._offload_nvme = zcfg.offload_optimizer == "nvme"
         self._offload_cpu = (not self._offload_nvme) and self.plan.wants_cpu_offload
         # device-kind shardings always exist; host-kind variants overlay them
@@ -137,6 +170,16 @@ class DeepSpeedTpuEngine:
             # NVMe tier: only bf16 compute params live on device; fp32
             # masters + Adam moments go to local SSD (runtime/offload.py)
             master_params, opt_state = self._init_nvme_offload(params, zcfg)
+        elif self._onebit:
+            from . import onebit
+
+            place_masters = jax.jit(
+                lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p),
+                out_shardings=self.master_shardings_dev,
+            )
+            master_params = place_masters(params)
+            opt_state, self.opt_shardings = onebit.init_state(self, master_params)
+            self.opt_shardings_dev = self.opt_shardings
         else:
             # place masters sharded-at-creation via a device-kind jit (host
             # out_shardings inside jit are TPU-only), then hop memory kinds
@@ -235,6 +278,9 @@ class DeepSpeedTpuEngine:
     def _micro_value_and_grad(self, master_params, micro_batch, rng, scale):
         """Loss+grads for one micro-batch, w.r.t. fp32 masters, computed
         through compute-dtype casts (the BF16_Optimizer linkage, bf16_optimizer.py:34)."""
+        if self._zeropp_vag is not None:
+            loss, grads = self._zeropp_vag(master_params, micro_batch, rng, scale)
+            return loss / scale, grads
 
         def scaled_loss(p):
             cp = precision.cast_floating(p, self.compute_dtype)
@@ -350,6 +396,9 @@ class DeepSpeedTpuEngine:
             if self._offload_nvme:
                 self._train_step = self._make_nvme_train_step(batch)
                 return self._train_step
+            if self._onebit:
+                self._train_step = self._make_onebit_train_step(batch)
+                return self._train_step
             step_fn = self._make_train_step()
             metrics_shardings = StepMetrics(
                 *([self._scalar_sharding] * len(StepMetrics._fields))
@@ -409,6 +458,33 @@ class DeepSpeedTpuEngine:
             return new_state, metrics
 
         return call
+
+    def _make_onebit_train_step(self, batch):
+        """Compressed-momentum optimizer family (runtime/onebit.py)."""
+        from . import onebit
+
+        raw_step = onebit.make_train_step(self)
+
+        def step_fn(state, batch_, rng):
+            new_state, (loss, gnorm, lr) = raw_step(state, batch_, rng)
+            metrics = StepMetrics(
+                loss=loss,
+                grad_norm=gnorm,
+                lr=lr,
+                loss_scale=jnp.asarray(1.0, jnp.float32),
+                skipped=jnp.asarray(False),
+            )
+            return new_state, metrics
+
+        metrics_shardings = StepMetrics(
+            *([self._scalar_sharding] * len(StepMetrics._fields))
+        )
+        return jax.jit(
+            step_fn,
+            in_shardings=(self.state_shardings, self.batch_sharding(batch, batch_dim=1), None),
+            out_shardings=(self.state_shardings, metrics_shardings),
+            donate_argnums=(0,),
+        )
 
     # ------------------------------------------------------------------
     # NVMe offload path (reference: partitioned_optimizer_swapper.py)

@@ -1,0 +1,191 @@
+"""ZeRO++: quantized weight gather (qwZ) and quantized gradient reduce (qgZ).
+
+Ports the communication-volume optimizations of the reference's ZeRO++
+(``runtime/zero/config.py:294-315`` knobs, CUDA quant kernels in
+``csrc/quantization/``, quantized 2-hop gradient reduce
+``runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce``):
+
+- **qwZ** (``zero_quantized_weights``): the per-step parameter all-gather on
+  the ``fsdp`` axis carries int8 + per-group fp32 scales instead of bf16 —
+  half the bytes on the wire.
+- **qgZ** (``zero_quantized_gradients``): the gradient reduce-scatter
+  becomes chunk → int8-quantize → ``all_to_all`` → dequantize-mean — the
+  reference's 2-hop quantized reduce with the hierarchy flattened onto ICI.
+
+Because the *reduction itself* must carry the compressed payload, the whole
+micro value-and-grad runs inside one ``shard_map`` manual over the DP axes
+(``data`` × ``fsdp``): gradients materialize as per-rank partials, the
+custom-VJP of the weight gather performs the quantized cross-rank reduce,
+and XLA never gets the chance to insert its own bf16 psum.  Both paths are
+lossy by design — that is the ZeRO++ trade.
+
+Caveat: activation sharding hints inside the loss (``shard_activation``)
+reference the manual axes and are suppressed for this step (the manual batch
+split already pins them).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.quantizer import dequantize, quantize_int8
+from ..parallel.topology import DATA_AXIS, FSDP_AXIS
+
+
+def _fsdp_dim(spec: P) -> Optional[int]:
+    for i, e in enumerate(tuple(spec)):
+        if e == FSDP_AXIS or (isinstance(e, tuple) and FSDP_AXIS in e):
+            return i
+    return None
+
+
+def _gather_leaf_fn(dim: int, w: int, out_dtype, quant_weights: bool,
+                    quant_grads: bool, data_axis: Optional[str]):
+    """custom_vjp: local master shard -> full compute param (inside shard_map).
+
+    bwd receives this rank's *partial* cotangent and returns the fully
+    reduced (mean over every DP rank) local shard gradient.
+    """
+
+    @jax.custom_vjp
+    def gather(local):
+        return _fwd_impl(local)
+
+    def _fwd_impl(local):
+        if quant_weights:
+            qt = quantize_int8(local)
+            q_all = jax.lax.all_gather(qt.data, FSDP_AXIS)  # int8 on the wire
+            s_all = jax.lax.all_gather(qt.scales, FSDP_AXIS)
+            pieces = [
+                dequantize(qt._replace(data=q_all[i], scales=s_all[i]), dtype=out_dtype)
+                for i in range(w)
+            ]
+        else:
+            g_all = jax.lax.all_gather(local.astype(out_dtype), FSDP_AXIS)
+            pieces = [g_all[i] for i in range(w)]
+        return jnp.concatenate(pieces, axis=dim)
+
+    def fwd(local):
+        return _fwd_impl(local), None
+
+    def bwd(_, g):
+        g = g.astype(jnp.float32)
+        if quant_grads:
+            # qgZ: int8 all_to_all + local dequant-mean (all_to_all_quant_reduce)
+            chunks = jnp.stack(jnp.split(g, w, axis=dim))  # [W, ...chunk]
+            qt = quantize_int8(chunks)
+            rows = qt.scales.shape[0] // w
+            recv_q = jax.lax.all_to_all(
+                qt.data, FSDP_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            recv_s = jax.lax.all_to_all(
+                qt.scales.reshape(w, rows), FSDP_AXIS, split_axis=0, concat_axis=0,
+                tiled=True,
+            )
+            recv_q = recv_q.reshape((w,) + chunks.shape[1:])
+            total = jnp.zeros(chunks.shape[1:], jnp.float32)
+            for i in range(w):
+                total = total + dequantize(
+                    qt._replace(data=recv_q[i], scales=recv_s.reshape(w, rows)[i]),
+                    dtype=jnp.float32,
+                )
+            out = total / w
+        else:
+            out = (
+                jax.lax.psum_scatter(g, FSDP_AXIS, scatter_dimension=dim, tiled=True)
+                / w
+            )
+        if data_axis is not None:
+            out = jax.lax.pmean(out, data_axis)
+        return (out,)
+
+    gather.defvjp(fwd, bwd)
+    return gather
+
+
+def make_micro_value_and_grad(
+    loss_fn,
+    mesh,
+    master_specs,
+    compute_dtype,
+    quant_weights: bool,
+    quant_grads: bool,
+):
+    """Returns ``fn(masters, micro_batch, rng, scale) -> (loss, grads)`` —
+    the ZeRO++ replacement for the engine's ``_micro_value_and_grad``.
+
+    ``grads`` come out sharded exactly like ``masters`` (fsdp shards), fully
+    reduced; ``loss`` is the global mean.
+    """
+    w = mesh.shape[FSDP_AXIS]
+    has_data = mesh.shape.get(DATA_AXIS, 1) > 1
+    data_axis = DATA_AXIS if has_data else None
+    dp_axes = (DATA_AXIS, FSDP_AXIS) if has_data else (FSDP_AXIS,)
+
+    specs_flat = master_specs
+
+    def in_spec_for(spec: P) -> P:
+        dim = _fsdp_dim(spec)
+        if dim is None:
+            return P()
+        return P(*[FSDP_AXIS if i == dim else None for i in range(dim + 1)])
+
+    master_in_specs = jax.tree_util.tree_map(in_spec_for, specs_flat)
+
+    def body(masters_local, micro_local, rng, scale):
+        def local_loss(ml):
+            def leaf(x, spec):
+                dim = _fsdp_dim(spec)
+                if dim is None or w == 1:
+                    return (
+                        x.astype(compute_dtype)
+                        if jnp.issubdtype(x.dtype, jnp.floating)
+                        else x
+                    )
+                return _gather_leaf_fn(
+                    dim, w, compute_dtype, quant_weights, quant_grads, data_axis
+                )(x)
+
+            cp = jax.tree_util.tree_map(leaf, ml, specs_flat)
+            return loss_fn(cp, micro_local, rng) * scale
+
+        loss, grads = jax.value_and_grad(local_loss)(masters_local)
+
+        def finish(g, spec):
+            if _fsdp_dim(spec) is None or w == 1:
+                return jax.lax.pmean(g.astype(jnp.float32), dp_axes)
+            return g  # custom bwd already reduced across every DP rank
+
+        grads = jax.tree_util.tree_map(finish, grads, specs_flat)
+        return jax.lax.pmean(loss, dp_axes), grads
+
+    batch_entry = dp_axes if has_data else FSDP_AXIS
+
+    def fn(masters, micro_batch, rng, scale):
+        from ..parallel import sharding as _sh
+
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: P(*((batch_entry,) + (None,) * (x.ndim - 1))), micro_batch
+        )
+        mapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(master_in_specs, batch_specs, P(), P()),
+            out_specs=(P(), master_in_specs),
+            axis_names=set(dp_axes),
+            check_vma=False,
+        )
+        # suppress ambient-mesh activation constraints that name manual axes
+        prev = _sh.get_current_mesh()
+        _sh.set_current_mesh(None)
+        try:
+            loss, grads = mapped(masters, micro_batch, rng, jnp.asarray(scale, jnp.float32))
+        finally:
+            _sh.set_current_mesh(prev)
+        return loss, grads
+
+    return fn
