@@ -33,6 +33,10 @@ def _tag(engine, tag: Optional[str]) -> str:
     return tag if tag is not None else f"global_step{engine.global_steps}"
 
 
+def _nvme_dir(path: str) -> str:
+    return os.path.join(path, "nvme_state")
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state=None):
     import orbax.checkpoint as ocp
 
@@ -43,10 +47,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     state = jax.tree_util.tree_map(lambda x: x, engine.state)  # shallow copy
     ckptr.save(os.path.join(path, "state"), state, force=True)
     nvme = getattr(engine, "_nvme_opt", None)
-    if nvme is not None:
+    if nvme is not None and jax.process_index() == 0:
         # NVMe tier: masters + Adam moments live in the swap pool, not the
-        # TrainState — persist them alongside (test_nvme_checkpointing.py)
-        nvme.save_to(os.path.join(path, "nvme_state"))
+        # TrainState — persist them alongside (test_nvme_checkpointing.py).
+        # Every process holds an identical replicated pool (grads are globally
+        # reduced), so only process 0 writes: N processes writing the same
+        # .swp names would race/clobber AND store N identical copies.
+        nvme.save_to(_nvme_dir(path))
     meta = {
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
@@ -107,7 +114,8 @@ def load_checkpoint(
     engine.state = state
     nvme = getattr(engine, "_nvme_opt", None)
     if nvme is not None and load_optimizer_states:
-        nvme.restore_from(os.path.join(path, "nvme_state"))
+        # every process restores from the single rank-0 copy
+        nvme.restore_from(_nvme_dir(path))
     with open(os.path.join(path, "meta.json")) as fh:
         meta = json.load(fh)
     engine.global_steps = int(meta["global_steps"])

@@ -31,7 +31,15 @@ def _grouped(x: jnp.ndarray, group_size: Optional[int]) -> Tuple[jnp.ndarray, in
     n = x.size
     gs = group_size or (x.shape[-1] if x.ndim else n)
     if n % gs:
-        gs = n  # degenerate: one group
+        # Degenerate fallback: one scale for the whole tensor. Loudly coarser
+        # than the caller asked for — warn instead of silently ignoring it.
+        from ..utils.logging import warning_once
+
+        warning_once(
+            f"quantizer: tensor size {n} not divisible by group_size {gs}; "
+            "falling back to a SINGLE quantization group for the whole tensor"
+        )
+        gs = n
     return x.reshape(n // gs, gs), gs
 
 

@@ -68,9 +68,34 @@ def filter_spec(shape, spec: P, mesh=None) -> P:
     ))
 
 
+def _drop_manual_axes(spec: P) -> P:
+    """Strip mesh axes that are Manual in the current trace (i.e. we are
+    inside a shard_map over them): with_sharding_constraint may only name
+    non-manual axes there.  Makes model code usable both under plain jit
+    (GSPMD) and inside whole-step shard_map optimizers (1-bit family)."""
+    try:
+        manual = set(jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception:  # very old tracing contexts
+        manual = set()
+    if not manual:
+        return spec
+
+    def clean(entry):
+        if entry is None:
+            return None
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if a not in manual)
+        return kept if len(kept) > 1 else (kept[0] if kept else None)
+
+    return P(*(clean(e) for e in tuple(spec)))
+
+
 def shard_activation(x: jax.Array, spec: P) -> jax.Array:
     if _CURRENT_MESH is None:
         return x
+    # strip manual axes FIRST: filter_spec's divisibility check must not count
+    # axes we're about to drop (their sizes don't apply to local block shapes)
+    spec = filter_spec(x.shape, _drop_manual_axes(spec))
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(_CURRENT_MESH, filter_spec(x.shape, spec))
+        x, NamedSharding(_CURRENT_MESH, spec)
     )

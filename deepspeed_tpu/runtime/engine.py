@@ -435,6 +435,19 @@ class DeepSpeedTpuEngine:
         )
         mode = {"v": None}
 
+        def unsupported_host_memory(e: Exception) -> bool:
+            # Only lowering/compile failures about host memory kinds mean
+            # "backend unsupported"; anything else (OOM, user loss error at
+            # first execution) must propagate, not silently switch modes.
+            if not isinstance(e, (ValueError, TypeError, NotImplementedError,
+                                  jax.errors.JaxRuntimeError)):
+                return False
+            msg = str(e).lower()
+            return any(k in msg for k in (
+                "memory kind", "memory_kind", "pinned_host", "host memory",
+                "memory space", "memory_space",
+            ))
+
         def call(state, batch_, rng):
             if mode["v"] in (None, "host"):
                 try:
@@ -442,7 +455,7 @@ class DeepSpeedTpuEngine:
                     mode["v"] = "host"
                     return out
                 except Exception as e:  # noqa: BLE001 — backend capability probe
-                    if mode["v"] == "host":
+                    if mode["v"] == "host" or not unsupported_host_memory(e):
                         raise
                     log_dist(
                         f"host-memory jit unsupported here ({type(e).__name__}); "

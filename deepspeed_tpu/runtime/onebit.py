@@ -136,19 +136,36 @@ def make_train_step(engine):
             jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         )
 
+        # Bias correction uses t clamped at freeze_step: warmup is exact dense
+        # Adam (parity-tested vs optax), and after the freeze the correction
+        # factors stop evolving along with the frozen variance, so the
+        # effective step size is CONTINUOUS across the boundary.  (The
+        # reference OnebitAdam applies no bias correction in either phase —
+        # onebit/adam.py:198,230 `exp_avg / (exp_avg_sq.sqrt() + eps)`; ours
+        # differs by a fixed factor ≈ sqrt(1 - b2^freeze) after warmup, a
+        # deliberate deviation to keep warmup == dense Adam.)
+        t = (jnp.minimum(step, freeze_step) + 1).astype(jnp.float32)
+
         def warmup(_):
             g = jax.lax.pmean(gflat, axes)
             m2 = b1 * m + (1.0 - b1) * g
             v2 = b2 * v + (1.0 - b2) * g * g
-            return m2, v2, errw, errs
+            # exact global grad norm: the dense pmean already happens here
+            gnorm = jnp.linalg.norm(g)
+            return m2, v2, errw, errs, gnorm
 
         def compressed(_):
             m_local = b1 * m + (1.0 - b1) * gflat
             m_avg, errw2, errs2 = compressed_allreduce(m_local, errw, errs, axes)
-            return m_avg, v, errw2, errs2
+            # No dense collective in the compressed phase (that would negate the
+            # 1-bit bandwidth savings): report the norm of the already-averaged
+            # compressed momentum as the gradient-scale proxy.
+            gnorm = jnp.linalg.norm(m_avg)
+            return m_avg, v, errw2, errs2, gnorm
 
-        m2, v2, errw2, errs2 = jax.lax.cond(step < freeze_step, warmup, compressed, None)
-        t = (step + 1).astype(jnp.float32)
+        m2, v2, errw2, errs2, gnorm = jax.lax.cond(
+            step < freeze_step, warmup, compressed, None
+        )
         mhat = m2 / (1.0 - b1**t)
         vhat = v2 / (1.0 - b2**t)
         upd_flat = -mhat / (jnp.sqrt(vhat) + eps)
@@ -166,7 +183,6 @@ def make_train_step(engine):
             return (p.astype(jnp.float32) + lr * u).astype(p.dtype)
 
         new_params = jax.tree_util.tree_map(apply_leaf, params, upd)
-        gnorm = jnp.linalg.norm(jax.lax.pmean(gflat, axes))
         return new_params, m2, v2, errw2[None], errs2[None], loss, gnorm, lr
 
     def train_step(state, batch, rng):
