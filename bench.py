@@ -44,8 +44,11 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        cfg = get_preset("llama3_proxy_410m", remat="full")
-        micro, seq, steps = 4, 4096, 10
+        # winning r3 config: selective remat (save q/k/v/attn, recompute MLP
+        # intermediates), chunked vocab CE, micro=8 — measured 0.52 MFU on
+        # v5e vs 0.32 for r2's remat=full micro=4 stage-1 config
+        cfg = get_preset("llama3_proxy_410m", remat="selective", loss_chunk_size=2048)
+        micro, seq, steps = 8, 4096, 10
     else:  # smoke-test mode off-TPU so the script always completes
         cfg = get_preset("tiny", max_seq_len=256)
         micro, seq, steps = 2, 256, 3
@@ -55,7 +58,10 @@ def main():
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.1}},
-        "zero_optimization": {"stage": 1},
+        # north-star path: ZeRO-3 (BASELINE.json); persistence threshold 0
+        # forces the full cast/gather machinery through the compiler even on
+        # a single chip (fsdp=1 shards are degenerate but the code path runs)
+        "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
         "bf16": {"enabled": True},
         "steps_per_print": 1000000,
     }

@@ -123,6 +123,8 @@ def initialize(
     from .monitor.monitor import MonitorMaster
 
     engine.monitor = MonitorMaster(cfg)
+    if model is not None and not isinstance(model, str):
+        engine.model = model  # flops profiler reads .cfg for its module tree
 
     dataloader = None
     if training_data is not None:
@@ -134,6 +136,8 @@ def initialize(
             collate_fn=collate_fn,
             seed=cfg.seed,
         )
+    if dataloader is not None:
+        engine.training_dataloader = dataloader  # sampler state rides checkpoints
     if lr_scheduler is not None:
         log_dist("external lr_scheduler object ignored; use config['scheduler']")
     return engine, engine, dataloader, engine.lr_scheduler

@@ -62,6 +62,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         "zero_stage": engine.config.zero_optimization.stage,
         "dp_world_size": engine.grid.dp_world_size,
     }
+    loader = getattr(engine, "training_dataloader", None)
+    if loader is not None and hasattr(loader, "state_dict"):
+        # resumable data position (reference: engine checkpoints the
+        # data-sampler consumed_samples the same way)
+        meta["data_sampler"] = loader.state_dict()
+    if getattr(engine, "curriculum_scheduler", None) is not None:
+        meta["curriculum"] = engine.curriculum_scheduler.get_state()
     if jax.process_index() == 0:
         # rank-0 only: every process writing meta.json races on shared
         # filesystems (the reference guards all non-sharded files this way)
@@ -122,6 +129,11 @@ def load_checkpoint(
     engine.skipped_steps = int(meta.get("skipped_steps", 0))
     if load_lr_scheduler_states and "lr_scheduler" in meta:
         engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    loader = getattr(engine, "training_dataloader", None)
+    if loader is not None and hasattr(loader, "load_state_dict") and "data_sampler" in meta:
+        loader.load_state_dict(meta["data_sampler"])
+    if getattr(engine, "curriculum_scheduler", None) is not None and "curriculum" in meta:
+        engine.curriculum_scheduler.set_state(meta["curriculum"])
     log_dist(f"loaded checkpoint {path}")
     return path, meta.get("client_state", {})
 

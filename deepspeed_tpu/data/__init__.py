@@ -1,0 +1,11 @@
+"""Data pipeline: resumable sampler, curriculum scheduler, mmap datasets.
+
+TPU-native analogue of ``deepspeed/runtime/data_pipeline/`` (data_sampler.py,
+curriculum_scheduler.py, indexed_dataset.py).
+"""
+from .curriculum_scheduler import CurriculumScheduler, truncate_to_seqlen  # noqa: F401
+from .indexed_dataset import (  # noqa: F401
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+from .sampler import DeepSpeedDataSampler, find_fit_int_dtype  # noqa: F401

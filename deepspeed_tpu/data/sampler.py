@@ -1,0 +1,150 @@
+"""Deterministic, resumable data sampler.
+
+TPU-native counterpart of the reference's ``DeepSpeedDataSampler``
+(``runtime/data_pipeline/data_sampling/data_sampler.py:36``): the sampler
+owns the global sample order (seeded shuffle per epoch), yields per-step
+index batches, and its entire position is one integer — ``consumed_samples``
+— captured in ``state_dict()`` and restored bit-exactly by
+``load_state_dict()`` (the reference checkpoints the same counter through
+the engine's data-sampler state).
+
+Unlike a torch sampler there are no worker processes to coordinate: the
+order is a pure function of (seed, epoch), so resume = recompute the epoch
+permutation and skip.  Every DP rank runs the same sampler and slices its
+strided shard (``get_start_end_idx`` mirrors the reference's rank split).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+def find_fit_int_dtype(min_value: int, max_value: int):
+    """Smallest numpy int dtype covering [min_value, max_value] (reference:
+    data_sampling/utils.py)."""
+    for dt in (np.uint8, np.uint16, np.uint32, np.uint64):
+        if max_value <= np.iinfo(dt).max and min_value >= 0:
+            return dt
+    return np.int64
+
+
+class DeepSpeedDataSampler:
+    """Yields global index batches of ``micro_batch * dp_size * gas`` samples.
+
+    Iteration state is exactly ``consumed_samples``; difficulty-based
+    filtering hooks in via ``index_filter`` (curriculum clusters in the
+    reference; a callable here, applied per epoch).
+    """
+
+    def __init__(
+        self,
+        one_epoch_total_samples: int,
+        micro_batch_size: int,
+        data_parallel_rank: int = 0,
+        data_parallel_size: int = 1,
+        gradient_accumulation_steps: int = 1,
+        num_epochs: int = 1,
+        seed: int = 0,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        index_filter=None,
+    ):
+        if one_epoch_total_samples <= 0:
+            raise ValueError(f"no sample to consume: {one_epoch_total_samples}")
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError(
+                f"data_parallel_rank {data_parallel_rank} >= size {data_parallel_size}"
+            )
+        self.one_epoch_total_samples = one_epoch_total_samples
+        self.index_dtype = find_fit_int_dtype(0, one_epoch_total_samples)
+        self.total_samples = one_epoch_total_samples * num_epochs
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.gradient_accumulation_steps = gradient_accumulation_steps
+        self.micro_batch_times_data_parallel_size = micro_batch_size * data_parallel_size
+        self.global_batch_size = (
+            self.micro_batch_times_data_parallel_size * gradient_accumulation_steps
+        )
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.index_filter = index_filter
+        self.consumed_samples = 0
+        self._order_cache: Optional[tuple] = None  # (epoch, order)
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    # -- deterministic order -------------------------------------------------
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        order = np.arange(self.one_epoch_total_samples, dtype=self.index_dtype)
+        if self.index_filter is not None:
+            order = np.asarray(self.index_filter(order, epoch), dtype=self.index_dtype)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + epoch)
+            rng.shuffle(order)
+        return order
+
+    def get_start_end_idx(self, batch_len: Optional[int] = None):
+        """This DP rank's slice of a global micro batch (reference
+        data_sampler.py:122)."""
+        batch_len = batch_len or self.micro_batch_times_data_parallel_size
+        start = round(self.data_parallel_rank * batch_len / self.data_parallel_size)
+        end = round((self.data_parallel_rank + 1) * batch_len / self.data_parallel_size)
+        return start, end
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Yield [global_batch_size] index arrays, resuming at
+        consumed_samples."""
+        while self.consumed_samples < self.total_samples:
+            epoch_len = self.one_epoch_total_samples
+            epoch = self.consumed_samples // epoch_len
+            within = self.consumed_samples % epoch_len
+            # the permutation is O(epoch_len): compute once per epoch, not
+            # per batch
+            if self._order_cache is None or self._order_cache[0] != epoch:
+                self._order_cache = (epoch, self._epoch_order(epoch))
+            order = self._order_cache[1]
+            usable = (len(order) // self.global_batch_size) * self.global_batch_size
+            if usable == 0:
+                # dataset (after filtering) smaller than one global batch:
+                # nothing will ever be yielded — terminate instead of
+                # spinning through empty epochs
+                return
+            if within >= usable:
+                # trailing partial batch dropped (static shapes): skip ahead
+                self.consumed_samples = (epoch + 1) * epoch_len
+                continue
+            batch = order[within : within + self.global_batch_size]
+            self.consumed_samples += self.global_batch_size
+            # epoch boundary bookkeeping: if this batch completes the usable
+            # range, charge the dropped tail so epoch accounting stays exact
+            if within + self.global_batch_size >= usable:
+                self.consumed_samples = (epoch + 1) * epoch_len
+            yield batch.astype(np.int64)
+
+    def local_slice(self, global_batch: np.ndarray) -> np.ndarray:
+        """[gas, local_micro] view of this rank's samples in a global batch."""
+        per_micro = self.micro_batch_times_data_parallel_size
+        out: List[np.ndarray] = []
+        for g in range(self.gradient_accumulation_steps):
+            micro = global_batch[g * per_micro : (g + 1) * per_micro]
+            start, end = self.get_start_end_idx(len(micro))
+            out.append(micro[start:end])
+        return np.stack(out)
+
+    # -- checkpoint state (reference: state_dict/load_state_dict) ------------
+    def state_dict(self) -> Dict[str, int]:
+        return {"consumed_samples": self.consumed_samples, "seed": self.seed}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        if state.get("seed", self.seed) != self.seed:
+            from ..utils.logging import warning_once
+
+            warning_once(
+                "data sampler restored with a different seed; the resumed "
+                "sample order will not match the original run"
+            )
+        self.consumed_samples = int(state["consumed_samples"])

@@ -43,12 +43,14 @@ register("llama3_70b", TransformerConfig(
     num_heads=64, num_kv_heads=8, max_seq_len=8192, rope_theta=500_000.0,
     remat="full", attn_impl="auto"))
 
-# ~410M-param Llama-3-shaped proxy: same GQA ratio/norm/act, fits one v5e chip
-# with fp32 masters + Adam state.  This is the bench.py flagship workload.
+# ~410M-param Llama-3-shaped proxy: same GQA ratio (4:1) and the real
+# Llama-3 head_dim of 128 (MXU-native: fills the 128-deep systolic array;
+# hd=64 halves attention-matmul efficiency), RMSNorm/SwiGLU/RoPE, fits one
+# v5e chip with fp32 masters + Adam state.  bench.py flagship workload.
 register("llama3_proxy_410m", TransformerConfig(
     vocab_size=32128, hidden_size=1024, intermediate_size=4096, num_layers=24,
-    num_heads=16, num_kv_heads=4, max_seq_len=4096, rope_theta=500_000.0,
-    remat="dots", attn_impl="auto"))
+    num_heads=8, num_kv_heads=2, max_seq_len=4096, rope_theta=500_000.0,
+    remat="selective", attn_impl="auto"))
 
 # --- Mistral / Mixtral ------------------------------------------------------
 register("mistral_7b", TransformerConfig(

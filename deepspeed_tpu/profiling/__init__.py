@@ -1,0 +1,17 @@
+"""Profiling subsystem: flops profiler + compiled-step cost/memory analysis.
+
+TPU-native analogue of ``deepspeed/profiling/`` (flops_profiler/profiler.py).
+"""
+from .flops_profiler import (  # noqa: F401
+    FlopsProfiler,
+    ModuleProfile,
+    analyze_train_step,
+    compiled_analysis,
+    duration_to_string,
+    flops_to_string,
+    get_model_profile,
+    macs_to_string,
+    model_tree,
+    number_to_string,
+    params_to_string,
+)

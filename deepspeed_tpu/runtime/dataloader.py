@@ -54,19 +54,31 @@ class DeepSpeedTpuDataLoader:
         drop_last: bool = True,
         collate_fn: Optional[Callable] = None,
         global_batches: bool = True,
+        num_epochs: Optional[int] = None,
     ):
+        from ..data.sampler import DeepSpeedDataSampler
+
         self.dataset = dataset
         self.micro_batch_size = micro_batch_size
         self.gas = gradient_accumulation_steps
         self.dp_world_size = dp_world_size
         self.dp_rank = dp_rank
-        self.shuffle = shuffle
-        self.seed = seed
-        self.epoch = 0
-        self.drop_last = drop_last
         self.collate_fn = collate_fn or _default_collate
         # single-process: emit full global batches; multi-host: per-rank shards
         self.global_batches = global_batches
+        # ordering + resume state live in the sampler (deepspeed_tpu/data/)
+        self.data_sampler = DeepSpeedDataSampler(
+            one_epoch_total_samples=len(dataset),
+            micro_batch_size=micro_batch_size,
+            data_parallel_rank=dp_rank,
+            data_parallel_size=dp_world_size,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+            # None = unbounded epochs (each __iter__ yields one epoch, fresh
+            # shuffle per epoch — the pre-sampler loader semantics)
+            num_epochs=num_epochs if num_epochs is not None else 2**31,
+            seed=seed,
+            shuffle=shuffle,
+        )
         per_step = micro_batch_size * dp_world_size * self.gas
         # static shapes are a TPU requirement: partial trailing batches are
         # always dropped (drop_last=False would break jit compilation caching)
@@ -80,33 +92,47 @@ class DeepSpeedTpuDataLoader:
             )
 
     def set_epoch(self, epoch: int):
-        self.epoch = epoch
+        """Jump the sampler to the start of ``epoch`` (torch-sampler parity)."""
+        self.data_sampler.consumed_samples = (
+            epoch * self.data_sampler.one_epoch_total_samples
+        )
+
+    @property
+    def epoch(self) -> int:
+        return (
+            self.data_sampler.consumed_samples
+            // self.data_sampler.one_epoch_total_samples
+        )
 
     def __len__(self):
         return self.batches_per_epoch
 
+    # -- resumable position (captured by engine checkpoints) ----------------
+    def state_dict(self):
+        return self.data_sampler.state_dict()
+
+    def load_state_dict(self, state) -> None:
+        self.data_sampler.load_state_dict(state)
+
     def __iter__(self) -> Iterator[Any]:
-        n = len(self.dataset)
-        order = np.arange(n)
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            rng.shuffle(order)
-        per_step = self.micro_batch_size * self.dp_world_size * self.gas
-        for start in range(0, (n // per_step) * per_step, per_step):
-            idx = order[start : start + per_step]
+        """Yield one epoch of batches (resuming mid-epoch after a restore)."""
+        import jax
+
+        s = self.data_sampler
+        if s.consumed_samples >= s.total_samples:
+            s.consumed_samples = 0
+        epoch0 = self.epoch
+        for idx in s:
             if not self.global_batches:
                 # deterministic per-rank interleave (reference uses
-                # DistributedSampler semantics: rank-strided)
-                idx = idx.reshape(self.gas, self.dp_world_size, self.micro_batch_size)[
-                    :, self.dp_rank
-                ].reshape(-1)
+                # DistributedSampler semantics via get_start_end_idx)
+                idx = self.data_sampler.local_slice(idx).reshape(-1)
             samples = [self.dataset[int(i)] for i in idx]
             batch = self.collate_fn(samples)
             gas_fold = lambda x: x.reshape((self.gas, x.shape[0] // self.gas) + x.shape[1:])
-            import jax
-
             yield jax.tree_util.tree_map(gas_fold, batch)
-        self.epoch += 1
+            if self.epoch != epoch0:
+                break
 
 
 def _default_collate(samples: Sequence[Any]):

@@ -241,6 +241,20 @@ class DeepSpeedTpuEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self._last_metrics: Optional[StepMetrics] = None
+        self.model = None  # attached by initialize() for the flops profiler
+        self.training_dataloader = None  # attached by initialize(); its
+        # sampler position rides engine checkpoints (checkpoint/saving.py)
+        self.curriculum_scheduler = None
+        cl = (config.data_efficiency.curriculum_learning or {})
+        if config.data_efficiency.enabled and cl.get("enabled"):
+            from ..data.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+            self._curriculum_metric = cl.get("curriculum_type", "seqlen")
+            log_dist(
+                f"curriculum learning enabled: metric={self._curriculum_metric} "
+                f"schedule={cl.get('schedule_type')}"
+            )
         log_dist(
             f"engine ready: zero_stage={config.zero_optimization.stage} "
             f"mesh={grid.spec.sizes} dtype={config.precision_dtype} "
@@ -630,6 +644,17 @@ class DeepSpeedTpuEngine:
             batch = jax.tree_util.tree_map(
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch
             )
+        if self.curriculum_scheduler is not None:
+            # reference: curriculum difficulty advances per global step and
+            # (for the seqlen metric) truncates the batch — each distinct
+            # difficulty is one cached XLA compilation
+            difficulty = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1
+            )
+            if self._curriculum_metric == "seqlen":
+                from ..data.curriculum_scheduler import truncate_to_seqlen
+
+                batch = truncate_to_seqlen(batch, difficulty)
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
         rng = self._next_rng()
@@ -644,7 +669,31 @@ class DeepSpeedTpuEngine:
         )
         self.tput_timer.stop(sync_obj=metrics.loss)
         self._emit_monitor(metrics)
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == fp.profile_step:
+            # before the wall-clock log below: log(reset=True) zeroes the
+            # step timer the profiler reads its latency from
+            self._run_flops_profiler(batch)
+        if (
+            self.config.wall_clock_breakdown
+            and self.global_steps % self.config.steps_per_print == 0
+        ):
+            # reference: EngineTimers groups logged per steps_per_print
+            self.timers.log(
+                [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER],
+                reset=True,
+            )
         return metrics.loss
+
+    def _run_flops_profiler(self, batch) -> None:
+        """Engine-integrated flops profiler firing at ``profile_step``
+        (reference engine.py:1938-1955)."""
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        prof = FlopsProfiler(model=self.model, engine=self)
+        timer = self.timers(STEP_GLOBAL_TIMER)
+        prof._duration = (timer.mean() or 0.0) / 1000.0
+        prof.engine_step_hook(self, batch)
 
     # ------------------------------------------------------------------
     # public API — forward/backward/step parity shim
