@@ -92,6 +92,22 @@ def initialize(
         if params is None:
             params = loaded
 
+    aq = (cfg.compression_training.activation_quantization or {})
+    if (
+        aq.get("shared_parameters", {}).get("enabled")
+        and model is not None
+        and hasattr(model, "cfg")
+        and hasattr(model.cfg, "act_quant_bits")
+    ):
+        # wire activation fake-quant into the model family (the engine-side
+        # CompressionManager only transforms weights — activations live
+        # inside the model's forward)
+        groups = aq.get("different_groups", {}) or {}
+        first = next(iter(groups.values()), {})
+        bits = int(first.get("params", {}).get("bits", 8))
+        model.cfg = model.cfg.replace(act_quant_bits=bits)
+        log_dist(f"activation quantization: {bits}-bit STE on sublayer inputs")
+
     if model is not None and loss_fn is None:
         loss_fn = model.loss_fn
         if params is None:
@@ -109,6 +125,48 @@ def initialize(
     if mesh is None:
         axes = _mesh_axes_from_config(cfg, jax.device_count(), cfg.zero_optimization.stage)
         mesh = initialize_mesh(**axes)
+    if cfg.elasticity.get("enabled"):
+        # reference engine.py:594-604: adopt the elastic batch size and
+        # verify this world size is in the compatible set
+        from .elasticity import ElasticityConfigError, compute_elastic_config
+
+        final_batch, valid_gpus, micro = compute_elastic_config(
+            {"elasticity": cfg.elasticity},
+            world_size=mesh.dp_world_size,
+            return_microbatch=True,
+        )
+        # reference semantics (engine.py:594-604): elastic values ALWAYS win;
+        # user-provided batch params are a config error unless
+        # ignore_non_elastic_batch_info suppresses the conflict check
+        user_batch_info = any(
+            v is not None for v in (
+                cfg.train_batch_size,
+                cfg.train_micro_batch_size_per_gpu,
+                cfg.gradient_accumulation_steps,
+            )
+        )
+        if user_batch_info and not cfg.elasticity.get(
+            "ignore_non_elastic_batch_info", False
+        ):
+            raise ElasticityConfigError(
+                "elasticity is enabled but batch sizes are also set in the "
+                "config; remove train_batch_size/"
+                "train_micro_batch_size_per_gpu/gradient_accumulation_steps "
+                "or set elasticity.ignore_non_elastic_batch_info"
+            )
+        if micro is None:
+            raise ElasticityConfigError(
+                f"no micro batch in {cfg.elasticity.get('micro_batch_sizes')} "
+                f"divides elastic batch {final_batch} at world size "
+                f"{mesh.dp_world_size}"
+            )
+        cfg.train_batch_size = final_batch
+        cfg.train_micro_batch_size_per_gpu = micro
+        cfg.gradient_accumulation_steps = final_batch // (micro * mesh.dp_world_size)
+        log_dist(
+            f"elasticity: train_batch_size={final_batch} micro={micro} "
+            f"valid world sizes={valid_gpus}"
+        )
     cfg.finalize(mesh.dp_world_size)
     comm.comm.configure(cfg.comms_logger)
 

@@ -1,0 +1,9 @@
+"""Compression: QAT weight quant, activation quant, magnitude pruning
+(reference deepspeed/compression/)."""
+from .compress import (  # noqa: F401
+    CompressionManager,
+    fake_quantize,
+    init_compression,
+    magnitude_prune_mask,
+    quantize_activation,
+)

@@ -1,0 +1,284 @@
+"""Compression subsystem: QAT weight quantization, activation quantization,
+magnitude pruning — driven by the reference's config schema.
+
+Reference: ``deepspeed/compression/compress.py:100 init_compression`` replaces
+nn.Linear modules with compression-aware ones (``basic_layer.py
+LinearLayer_Compress``) whose forwards fake-quantize/prune on a step
+schedule (``scheduler.py``, constants in ``compression/constants.py``).
+
+TPU-first formulation: compression is a **pure transform over the param
+pytree applied inside the jitted train step** — no module surgery.  The
+engine composes ``CompressionManager.transform(params, step)`` between the
+master→compute-dtype cast and the user's loss; straight-through estimation
+(``x + stop_gradient(fq(x) - x)``) makes the fake-quant/prune transparent to
+the gradient, exactly like the reference's autograd-function STE
+(``compression/utils.py``).  Schedules are traced with the step scalar, so
+one compiled program serves the whole bit/sparsity ramp.
+
+Config schema (reference keys):
+
+    "compression_training": {
+      "weight_quantization": {
+        "shared_parameters": {"enabled": true, "quantizer_kernel": false,
+          "schedule_offset": 100, "quantize_groups": 1,
+          "quantization_type": "symmetric", "rounding": "nearest"},
+        "different_groups": {"wq1": {
+          "params": {"start_bits": 8, "target_bits": 4,
+                     "quantization_period": 50},
+          "modules": ["layers/mlp"]}}},
+      "activation_quantization": {
+        "shared_parameters": {"enabled": true, "quantization_type":
+          "symmetric", "range_calibration": "dynamic",
+          "schedule_offset": 100},
+        "different_groups": {"aq1": {"params": {"bits": 8},
+                                     "modules": ["..."]}}},
+      "sparse_pruning": {
+        "shared_parameters": {"enabled": true, "method": "l1",
+          "schedule_offset": 100},
+        "different_groups": {"sp1": {"params": {"dense_ratio": 0.5},
+                                     "modules": ["layers/mlp"]}}}
+    }
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+def _ste(x: jnp.ndarray, transformed: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through estimator: forward = transformed, grad = identity."""
+    return x + jax.lax.stop_gradient(transformed - x)
+
+
+# ---------------------------------------------------------------------------
+# core fake-quant / prune math (jit-traceable in the step)
+# ---------------------------------------------------------------------------
+def fake_quantize(
+    x: jnp.ndarray,
+    bits: jnp.ndarray | int,
+    symmetric: bool = True,
+    groups: int = 1,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Group-wise fake quantization with dynamic bit width.
+
+    ``bits`` may be a traced scalar (the scheduler ramps start→target bits
+    without recompiling).  Matches the reference quantizer semantics
+    (symmetric: scale = amax / qmax; asymmetric: affine min/max).
+    """
+    orig_shape, orig_dtype = x.shape, x.dtype
+    xf = x.astype(jnp.float32).reshape(groups, -1)
+    qmax = 2.0 ** (jnp.asarray(bits, jnp.float32) - 1.0) - 1.0
+    if symmetric:
+        scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-12)
+        q = xf / scale
+        if stochastic and rng is not None:
+            q = jnp.floor(q + jax.random.uniform(rng, q.shape))
+        else:
+            q = jnp.round(q)
+        q = jnp.clip(q, -qmax - 1.0, qmax)
+        out = q * scale
+    else:
+        levels = 2.0 * qmax + 1.0
+        lo = jnp.min(xf, axis=-1, keepdims=True)
+        hi = jnp.max(xf, axis=-1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / levels, 1e-12)
+        q = (xf - lo) / scale
+        q = (jnp.floor(q + jax.random.uniform(rng, q.shape))
+             if stochastic and rng is not None else jnp.round(q))
+        out = jnp.clip(q, 0.0, levels) * scale + lo
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def magnitude_prune_mask(x: jnp.ndarray, dense_ratio: jnp.ndarray | float) -> jnp.ndarray:
+    """Keep the largest-|w| ``dense_ratio`` fraction (reference 'l1' method).
+    Threshold found by sort + dynamic index, so the ratio may be traced."""
+    flat = jnp.abs(x.astype(jnp.float32)).ravel()
+    n = flat.size
+    order = jnp.sort(flat)  # ascending
+    k = jnp.clip(
+        (n * (1.0 - jnp.asarray(dense_ratio, jnp.float32))).astype(jnp.int32), 0, n - 1
+    )
+    threshold = order[k]
+    return (jnp.abs(x.astype(jnp.float32)) >= threshold).astype(x.dtype)
+
+
+def quantize_activation(
+    x: jnp.ndarray, bits: int = 8, symmetric: bool = True, static_range: Optional[float] = None
+) -> jnp.ndarray:
+    """Activation fake-quant (reference activation_quantization; 'dynamic'
+    range = per-tensor amax, 'static' = provided range), STE for training."""
+    if static_range is not None:
+        qmax = 2.0 ** (bits - 1) - 1.0
+        scale = static_range / qmax
+        fq = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -qmax - 1, qmax) * scale
+        return _ste(x, fq.astype(x.dtype))
+    return _ste(x, fake_quantize(x, bits, symmetric=symmetric))
+
+
+# ---------------------------------------------------------------------------
+# config parsing (reference schema)
+# ---------------------------------------------------------------------------
+@dataclass
+class TechniqueGroup:
+    name: str
+    modules: List[str]  # regexes over param paths
+    params: Dict[str, Any]
+
+
+@dataclass
+class Technique:
+    enabled: bool = False
+    shared: Dict[str, Any] = field(default_factory=dict)
+    groups: List[TechniqueGroup] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, block: Optional[Dict]) -> "Technique":
+        if not block:
+            return cls()
+        shared = dict(block.get("shared_parameters", {}))
+        groups = [
+            TechniqueGroup(
+                name=name,
+                modules=list(g.get("modules", [".*"])),
+                params=dict(g.get("params", {})),
+            )
+            for name, g in (block.get("different_groups", {}) or {}).items()
+        ]
+        return cls(enabled=bool(shared.get("enabled", False)), shared=shared, groups=groups)
+
+    def group_for(self, path: str) -> Optional[TechniqueGroup]:
+        for g in self.groups:
+            if any(re.search(rx, path) for rx in g.modules):
+                return g
+        return None
+
+
+class CompressionManager:
+    """Holds parsed techniques; ``transform`` is traced into the train step."""
+
+    def __init__(self, config_dict: Dict):
+        cd = config_dict or {}
+        self.weight_quant = Technique.parse(cd.get("weight_quantization"))
+        self.act_quant = Technique.parse(cd.get("activation_quantization"))
+        self.pruning = Technique.parse(cd.get("sparse_pruning"))
+        if self.pruning.enabled:
+            method = self.pruning.shared.get("method", "l1")
+            if method not in ("l1", "topk"):
+                raise ValueError(
+                    f"sparse_pruning method '{method}' unsupported (l1|topk; "
+                    "snip_momentum needs the reference's neural_compressor)"
+                )
+
+    @property
+    def any_weight_transform(self) -> bool:
+        return (self.weight_quant.enabled and bool(self.weight_quant.groups)) or (
+            self.pruning.enabled and bool(self.pruning.groups)
+        )
+
+    # -- the traced transform ------------------------------------------------
+    def transform(self, params, step: jnp.ndarray):
+        """Apply QAT fake-quant + pruning masks to matching param leaves.
+        ``step`` is the traced global step: schedules (offset, bit ramp)
+        evaluate in-graph, one compiled program for the whole ramp."""
+        if not self.any_weight_transform:
+            return params
+        flat = _flatten_with_paths(params)
+        out = {}
+        for path, leaf in flat.items():
+            new = leaf
+            if self.weight_quant.enabled and leaf.ndim >= 2:
+                g = self.weight_quant.group_for(path)
+                if g is not None:
+                    new = self._apply_wq(new, g, step)
+            if self.pruning.enabled and leaf.ndim >= 2:
+                g = self.pruning.group_for(path)
+                if g is not None:
+                    new = self._apply_prune(new, g, step)
+            out[path] = new
+        return _unflatten_with_paths(params, out)
+
+    def _apply_wq(self, leaf, g: TechniqueGroup, step):
+        shared = self.weight_quant.shared
+        offset = int(shared.get("schedule_offset", 0))
+        start_bits = float(g.params.get("start_bits", 8))
+        target_bits = float(g.params.get("target_bits", start_bits))
+        period = float(g.params.get("quantization_period", 0) or 0)
+        if period > 0 and target_bits < start_bits:
+            # reference: bits shrink by 1 every doubling period after offset
+            steps_in = jnp.maximum(step.astype(jnp.float32) - offset, 0.0)
+            drops = jnp.floor(steps_in / period)
+            bits = jnp.clip(start_bits - drops, target_bits, start_bits)
+        else:
+            bits = jnp.asarray(target_bits, jnp.float32)
+        symmetric = shared.get("quantization_type", "symmetric") == "symmetric"
+        groups = int(shared.get("quantize_groups", 1))
+        fq = fake_quantize(leaf, bits, symmetric=symmetric, groups=groups)
+        active = step >= offset
+        return _ste(leaf, jnp.where(active, fq, leaf))
+
+    def _apply_prune(self, leaf, g: TechniqueGroup, step):
+        shared = self.pruning.shared
+        offset = int(shared.get("schedule_offset", 0))
+        dense_ratio = float(g.params.get("dense_ratio", 0.5))
+        mask = magnitude_prune_mask(leaf, dense_ratio)
+        active = step >= offset
+        pruned = leaf * mask
+        return _ste(leaf, jnp.where(active, pruned, leaf))
+
+    # -- export (redundancy_clean analogue) ---------------------------------
+    def export_params(self, params, step: Optional[int] = None):
+        """Hard-apply the final compression to a param tree
+        (reference ``redundancy_clean``/fix-compression path)."""
+        step_arr = jnp.asarray(10**9 if step is None else step, jnp.int32)
+        return jax.jit(lambda p: self.transform(p, step_arr))(params)
+
+
+def _flatten_with_paths(tree) -> Dict[str, Any]:
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        path = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in kp
+        )
+        flat[path] = leaf
+    return flat
+
+
+def _unflatten_with_paths(ref_tree, flat: Dict[str, Any]):
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(ref_tree)
+    leaves = []
+    for kp, _ in paths_leaves:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        leaves.append(flat[path])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def init_compression(engine_or_params, deepspeed_config: Dict, teacher_model=None, mpu=None):
+    """Reference-shaped entry (compress.py:100).
+
+    With an engine: installs the manager into the jitted step (the engine
+    consults ``engine._compression`` in its loss closure) and returns the
+    engine.  With a bare param tree: returns (params, manager) for manual
+    use with ``manager.transform``.
+    """
+    cd = deepspeed_config.get("compression_training", deepspeed_config) or {}
+    manager = CompressionManager(cd)
+    target = engine_or_params
+    if hasattr(target, "_micro_value_and_grad"):  # engine
+        target._compression = manager
+        target._train_step = None  # force re-trace with the transform inside
+        log_dist(
+            "compression initialized: "
+            f"wq={manager.weight_quant.enabled} "
+            f"aq={manager.act_quant.enabled} prune={manager.pruning.enabled}"
+        )
+        return target
+    return target, manager

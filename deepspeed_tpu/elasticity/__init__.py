@@ -1,0 +1,11 @@
+"""Elasticity: batch-size math for restart-at-any-scale (reference
+deepspeed/elasticity/)."""
+from .elasticity import (  # noqa: F401
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)

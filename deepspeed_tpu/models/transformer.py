@@ -75,6 +75,11 @@ class TransformerConfig:
     sequence_parallel: str = "none"
     # chunked logits+loss (FPDT_LogitsLoss analogue): 0 = full logits
     loss_chunk_size: int = 0
+    # activation fake-quant bits (compression subsystem wires this via
+    # initialize(); applied to sublayer inputs with STE).  Unlike the
+    # reference's schedule_offset-gated module hooks, quantization is active
+    # from step 0 — the loss_fn contract carries no step.
+    act_quant_bits: Optional[int] = None
 
     @property
     def hd(self) -> int:
@@ -294,13 +299,22 @@ def decoder_layer(
 ):
     """Pre-norm residual block. Returns (x, new_cache, aux_loss)."""
     dtype = x.dtype
+    attn_in = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.act_quant_bits:
+        from ..compression.compress import quantize_activation
+
+        attn_in = quantize_activation(attn_in, cfg.act_quant_bits)
     h, new_cache = attention_block(
-        lw["attn"], norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps), cfg,
+        lw["attn"], attn_in, cfg,
         positions, attn_fn, segment_ids, cache, cache_index,
     )
     x = shard_activation(x + h.astype(dtype), ACT_SPEC)
     aux = jnp.asarray(0.0, jnp.float32)
     y = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+    if cfg.act_quant_bits:
+        from ..compression.compress import quantize_activation
+
+        y = quantize_activation(y, cfg.act_quant_bits)
     if cfg.moe_num_experts > 0:
         from ..moe.layer import moe_block
 

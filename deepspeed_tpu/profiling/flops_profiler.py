@@ -250,8 +250,8 @@ class FlopsProfiler:
         if not leaves:
             return
         x = leaves[0]
-        if x.ndim >= 3:  # [gas, micro, seq]
-            self._batch, self._seq = int(x.shape[1]), int(x.shape[2]) - 1
+        if x.ndim >= 3:  # [gas, micro, seq] — the step runs gas*micro samples
+            self._batch, self._seq = int(x.shape[0] * x.shape[1]), int(x.shape[2]) - 1
         elif x.ndim == 2:
             self._batch, self._seq = int(x.shape[0]), int(x.shape[1]) - 1
 

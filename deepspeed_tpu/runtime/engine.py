@@ -244,6 +244,24 @@ class DeepSpeedTpuEngine:
         self.model = None  # attached by initialize() for the flops profiler
         self.training_dataloader = None  # attached by initialize(); its
         # sampler position rides engine checkpoints (checkpoint/saving.py)
+        self._compression = None
+        cc = config.compression_training
+        if cc.weight_quantization or cc.activation_quantization or cc.sparse_pruning:
+            from ..compression.compress import CompressionManager
+
+            manager = CompressionManager({
+                "weight_quantization": cc.weight_quantization,
+                "activation_quantization": cc.activation_quantization,
+                "sparse_pruning": cc.sparse_pruning,
+            })
+            if manager.any_weight_transform:
+                # weight-side transforms run in the step; activation quant is
+                # wired into the model forward by initialize()
+                self._compression = manager
+                log_dist(
+                    f"compression: wq={manager.weight_quant.enabled} "
+                    f"prune={manager.pruning.enabled}"
+                )
         self.curriculum_scheduler = None
         cl = (config.data_efficiency.curriculum_learning or {})
         if config.data_efficiency.enabled and cl.get("enabled"):
@@ -289,7 +307,7 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # the jitted train step
     # ------------------------------------------------------------------
-    def _micro_value_and_grad(self, master_params, micro_batch, rng, scale):
+    def _micro_value_and_grad(self, master_params, micro_batch, rng, scale, step=None):
         """Loss+grads for one micro-batch, w.r.t. fp32 masters, computed
         through compute-dtype casts (the BF16_Optimizer linkage, bf16_optimizer.py:34)."""
         if self._zeropp_vag is not None:
@@ -299,6 +317,10 @@ class DeepSpeedTpuEngine:
         def scaled_loss(p):
             cp = precision.cast_floating(p, self.compute_dtype)
             cp = zero.constrain(cp, self.param_shardings)
+            if self._compression is not None and step is not None:
+                # QAT fake-quant / pruning via STE inside the traced step
+                # (compression/compress.py; reference init_compression)
+                cp = self._compression.transform(cp, step)
             loss = self.loss_fn(cp, micro_batch, rng)
             return loss * scale
 
@@ -360,7 +382,7 @@ class DeepSpeedTpuEngine:
             divisor = scale
 
             def one_micro(p, micro, r):
-                loss, grads = self._micro_value_and_grad(p, micro, r, scale)
+                loss, grads = self._micro_value_and_grad(p, micro, r, scale, state.step)
                 # device-kind layout: grads live in HBM even when masters are
                 # offloaded (only the state pytree itself rides pinned_host)
                 grads = zero.constrain(grads, self.master_shardings_dev)
@@ -664,13 +686,18 @@ class DeepSpeedTpuEngine:
         if self.config.fp16.enabled and bool(metrics.skipped):
             self.skipped_steps += 1
         self.lr_scheduler.step()
+        fp = self.config.flops_profiler
+        profiling_now = fp.enabled and self.global_steps == fp.profile_step
         self.timers(STEP_GLOBAL_TIMER).stop(
-            sync_obj=metrics.loss if self.config.wall_clock_breakdown else None
+            # the profiler divides analytic FLOPs by this window: it must be
+            # a synced device time, not async dispatch time
+            sync_obj=metrics.loss
+            if (self.config.wall_clock_breakdown or profiling_now)
+            else None
         )
         self.tput_timer.stop(sync_obj=metrics.loss)
         self._emit_monitor(metrics)
-        fp = self.config.flops_profiler
-        if fp.enabled and self.global_steps == fp.profile_step:
+        if profiling_now:
             # before the wall-clock log below: log(reset=True) zeroes the
             # step timer the profiler reads its latency from
             self._run_flops_profiler(batch)
@@ -692,7 +719,9 @@ class DeepSpeedTpuEngine:
 
         prof = FlopsProfiler(model=self.model, engine=self)
         timer = self.timers(STEP_GLOBAL_TIMER)
-        prof._duration = (timer.mean() or 0.0) / 1000.0
+        # last step's synced duration, not mean(): the mean is polluted by
+        # step 1's trace+compile time (set profile_step >= 2 for a clean read)
+        prof._duration = timer.last()
         prof.engine_step_hook(self, batch)
 
     # ------------------------------------------------------------------
@@ -713,7 +742,9 @@ class DeepSpeedTpuEngine:
                     if self.config.fp16.enabled
                     else jnp.asarray(1.0, jnp.float32)
                 )
-                loss, grads = self._micro_value_and_grad(state.params, micro, rng, scale)
+                loss, grads = self._micro_value_and_grad(
+                    state.params, micro, rng, scale, state.step
+                )
                 grads = zero.constrain(grads, self.master_shardings_dev)
                 return loss, grads
 

@@ -44,9 +44,15 @@ class _Timer:
         if sync_obj is not None:
             _block(sync_obj)
         if record:
-            self._elapsed += time.perf_counter() - self._start
+            duration = time.perf_counter() - self._start
+            self._elapsed += duration
+            self._last = duration
             self._count += 1
         self.started = False
+
+    def last(self) -> float:
+        """Most recent recorded duration in seconds (0 if none)."""
+        return getattr(self, "_last", 0.0)
 
     def reset(self):
         self.started = False

@@ -1,0 +1,225 @@
+"""Compression tests (reference: tests/unit/compression/ semantics —
+fake-quant numerics, pruning masks, schedule gating, QAT near-parity)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (
+    CompressionManager,
+    fake_quantize,
+    init_compression,
+    magnitude_prune_mask,
+    quantize_activation,
+)
+
+
+def test_fake_quantize_roundtrip_error_scales_with_bits():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)), jnp.float32)
+    errs = []
+    for bits in (8, 4, 2):
+        fq = fake_quantize(x, bits)
+        errs.append(float(jnp.mean(jnp.abs(fq - x))))
+    assert errs[0] < errs[1] < errs[2]
+    # 8-bit symmetric round-trip is tight relative to the amax scale
+    assert errs[0] < float(jnp.max(jnp.abs(x))) / 127
+
+
+def test_fake_quantize_asymmetric_handles_offset_data():
+    x = jnp.asarray(np.random.default_rng(1).uniform(5.0, 6.0, (32, 32)), jnp.float32)
+    sym = fake_quantize(x, 4, symmetric=True)
+    asym = fake_quantize(x, 4, symmetric=False)
+    assert float(jnp.mean(jnp.abs(asym - x))) < float(jnp.mean(jnp.abs(sym - x)))
+
+
+def test_fake_quantize_traced_bits():
+    """bits as a traced scalar: one compiled program serves the ramp."""
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(16, 16)), jnp.float32)
+    f = jax.jit(lambda x, b: fake_quantize(x, b))
+    e8 = float(jnp.mean(jnp.abs(f(x, jnp.asarray(8.0)) - x)))
+    e3 = float(jnp.mean(jnp.abs(f(x, jnp.asarray(3.0)) - x)))
+    assert e8 < e3
+
+
+def test_magnitude_prune_mask_ratio():
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(50, 40)), jnp.float32)
+    for ratio in (0.75, 0.5, 0.25):
+        mask = magnitude_prune_mask(x, ratio)
+        frac = float(mask.mean())
+        assert abs(frac - ratio) < 0.02, (ratio, frac)
+        # kept entries are the largest-magnitude ones
+        kept_min = float(jnp.min(jnp.where(mask > 0, jnp.abs(x), jnp.inf)))
+        dropped_max = float(jnp.max(jnp.where(mask == 0, jnp.abs(x), -jnp.inf)))
+        assert kept_min >= dropped_max
+
+
+def test_activation_quant_ste_gradient_is_identity():
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(8, 8)), jnp.float32)
+    g = jax.grad(lambda x: quantize_activation(x, bits=8).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(g), atol=1e-6)
+
+
+WQ_CONFIG = {
+    "weight_quantization": {
+        "shared_parameters": {
+            "enabled": True,
+            "schedule_offset": 2,
+            "quantize_groups": 1,
+            "quantization_type": "symmetric",
+        },
+        "different_groups": {
+            "wq1": {
+                "params": {"start_bits": 8, "target_bits": 8},
+                "modules": [r"layers/mlp", r"layers/attn"],
+            }
+        },
+    },
+}
+
+
+def test_manager_schedule_gates_transform():
+    m = CompressionManager(WQ_CONFIG)
+    params = {"layers": {"mlp": {"w_up": jnp.asarray(
+        np.random.default_rng(5).normal(size=(16, 16)), jnp.float32)}}}
+    before = m.transform(params, jnp.asarray(0, jnp.int32))
+    np.testing.assert_array_equal(
+        np.asarray(before["layers"]["mlp"]["w_up"]),
+        np.asarray(params["layers"]["mlp"]["w_up"]),
+    )
+    after = m.transform(params, jnp.asarray(5, jnp.int32))
+    assert not np.array_equal(
+        np.asarray(after["layers"]["mlp"]["w_up"]),
+        np.asarray(params["layers"]["mlp"]["w_up"]),
+    )
+
+
+def test_bit_ramp_quantization_period():
+    cfg = {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"g": {
+                "params": {"start_bits": 8, "target_bits": 4,
+                           "quantization_period": 10},
+                "modules": [".*"],
+            }},
+        }
+    }
+    m = CompressionManager(cfg)
+    x = {"w": jnp.asarray(np.random.default_rng(6).normal(size=(32, 32)), jnp.float32)}
+    errs = [
+        float(jnp.mean(jnp.abs(
+            m.transform(x, jnp.asarray(s, jnp.int32))["w"] - x["w"]
+        )))
+        for s in (0, 15, 45)
+    ]
+    assert errs[0] < errs[1] < errs[2]  # bits shrink over the ramp
+
+
+def _train(config_extra, steps=30, lr=5e-3):
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": lr}},
+            **config_extra,
+        },
+        mesh=deepspeed_tpu.initialize_mesh(data=8),
+    )
+    rng = np.random.default_rng(7)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return np.asarray(losses)
+
+
+def test_qat_trains_to_near_parity():
+    """VERDICT item-7 'done' criterion: a tiny model under 8-bit QAT reaches
+    near-parity loss with the uncompressed run."""
+    base = _train({})
+    qat = _train({"compression_training": WQ_CONFIG})
+    assert np.isfinite(qat).all()
+    assert qat[-1] < qat[0] * 0.5  # it actually trains
+    assert qat[-1] < base[-1] + 0.35, (qat[-1], base[-1])
+
+
+def test_pruned_training_and_export():
+    prune_cfg = {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "method": "l1",
+                                  "schedule_offset": 3},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.7},
+                                         "modules": [r"layers/mlp"]}},
+        }
+    }
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "compression_training": prune_cfg,
+        },
+        mesh=deepspeed_tpu.initialize_mesh(data=8),
+    )
+    rng = np.random.default_rng(8)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # redundancy_clean analogue: exported mlp weights are ~30% zeros
+    exported = engine._compression.export_params(engine.state.params)
+    w = np.asarray(exported["layers"]["mlp"]["w_up"])
+    zero_frac = float((w == 0).mean())
+    assert 0.25 < zero_frac < 0.35, zero_frac
+
+
+def test_activation_quantization_wires_into_model():
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    model = CausalLM(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "compression_training": {
+                "activation_quantization": {
+                    "shared_parameters": {"enabled": True,
+                                          "quantization_type": "symmetric"},
+                    "different_groups": {"aq1": {"params": {"bits": 8},
+                                                 "modules": [".*"]}},
+                },
+            },
+        },
+        mesh=deepspeed_tpu.initialize_mesh(data=8),
+    )
+    assert model.cfg.act_quant_bits == 8  # wired into the model forward
+    assert engine._compression is None  # no weight transform installed
+    rng = np.random.default_rng(10)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_init_compression_on_engine():
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        },
+        mesh=deepspeed_tpu.initialize_mesh(data=8),
+    )
+    out = init_compression(engine, {"compression_training": WQ_CONFIG})
+    assert out is engine and engine._compression is not None
+    rng = np.random.default_rng(9)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 33)).astype(np.int32)}
+    assert np.isfinite(float(engine.train_batch(batch)))
