@@ -81,16 +81,21 @@ def initialize(
     if dist_init_required:
         comm.comm.init_distributed()
 
+    hf_dir = None
     if isinstance(model, str):
-        # HF checkpoint directory: import weights + config (the reference's
-        # load_state_dict-from-pretrained training init)
-        from .checkpoint.hf_import import load_hf_checkpoint
+        # HF checkpoint directory: config now, weights later — the streamed
+        # loader needs the mesh + sharding plan so tensors land directly in
+        # their shards (no full tree in host RAM)
+        import json as _json
+        import os as _os
+
+        from .checkpoint.hf_import import config_from_hf
         from .models.transformer import CausalLM
 
-        loaded, model_cfg = load_hf_checkpoint(model)
+        hf_dir = model
+        with open(_os.path.join(hf_dir, "config.json")) as fh:
+            model_cfg = config_from_hf(_json.load(fh))
         model = CausalLM(model_cfg)
-        if params is None:
-            params = loaded
 
     aq = (cfg.compression_training.activation_quantization or {})
     if (
@@ -110,14 +115,10 @@ def initialize(
 
     if model is not None and loss_fn is None:
         loss_fn = model.loss_fn
-        if params is None:
-            import jax
-
-            params = model.init_params(jax.random.PRNGKey(cfg.seed))
         if tp_rules is None:
             tp_rules = getattr(model, "tp_rules", None)
 
-    if loss_fn is None or params is None:
+    if loss_fn is None:
         raise ValueError("initialize() needs (loss_fn, params) or model=")
 
     import jax
@@ -125,14 +126,43 @@ def initialize(
     if mesh is None:
         axes = _mesh_axes_from_config(cfg, jax.device_count(), cfg.zero_optimization.stage)
         mesh = initialize_mesh(**axes)
+
+    if params is None:
+        if model is None:
+            raise ValueError("initialize() needs (loss_fn, params) or model=")
+        # zero.Init analogue (runtime/zero.py:init_sharded_params): build
+        # params straight into their plan shardings inside jit — the full
+        # tree never materializes on one host, so models larger than host
+        # RAM can initialize (reference zero/partition_parameters.py:824)
+        from .runtime import zero as zero_mod
+
+        key = jax.random.PRNGKey(cfg.seed)
+        shapes = jax.eval_shape(model.init_params, key)
+        plan = zero_mod.plan_sharding(
+            shapes, cfg.zero_optimization, mesh.spec, tp_rules
+        )
+        if hf_dir is not None:
+            from .checkpoint.hf_import import load_hf_checkpoint_sharded
+
+            params, model_cfg = load_hf_checkpoint_sharded(
+                hf_dir, plan, mesh.mesh, cfg=model.cfg
+            )
+            model.cfg = model_cfg  # tie_embeddings may have been corrected
+        else:
+            params = zero_mod.init_sharded_params(
+                model.init_params, key, plan, mesh.mesh
+            )
     if cfg.elasticity.get("enabled"):
         # reference engine.py:594-604: adopt the elastic batch size and
         # verify this world size is in the compatible set
         from .elasticity import ElasticityConfigError, compute_elastic_config
 
+        # v0.2 reasons in total chips and divides by model_parallel_size
+        # itself; dp_world_size already excludes model parallelism
+        mp = int(cfg.elasticity.get("model_parallel_size", 1))
         final_batch, valid_gpus, micro = compute_elastic_config(
             {"elasticity": cfg.elasticity},
-            world_size=mesh.dp_world_size,
+            world_size=mesh.dp_world_size * mp,
             return_microbatch=True,
         )
         # reference semantics (engine.py:594-604): elastic values ALWAYS win;

@@ -273,8 +273,27 @@ def init_compression(engine_or_params, deepspeed_config: Dict, teacher_model=Non
     manager = CompressionManager(cd)
     target = engine_or_params
     if hasattr(target, "_micro_value_and_grad"):  # engine
-        target._compression = manager
-        target._train_step = None  # force re-trace with the transform inside
+        if manager.any_weight_transform:
+            target._compression = manager
+            target._train_step = None  # force re-trace with the transform inside
+        if manager.act_quant.enabled:
+            # activations live inside the model forward — wire the bits into
+            # the model config (same as initialize() does)
+            model = getattr(target, "model", None)
+            if model is not None and hasattr(model, "cfg") and hasattr(
+                model.cfg, "act_quant_bits"
+            ):
+                groups = manager.act_quant.groups
+                bits = int(groups[0].params.get("bits", 8)) if groups else 8
+                model.cfg = model.cfg.replace(act_quant_bits=bits)
+                target._train_step = None
+            else:
+                raise ValueError(
+                    "activation_quantization needs a model adapter exposing "
+                    ".cfg.act_quant_bits (deepspeed_tpu.models CausalLM); "
+                    "for custom loss_fns apply "
+                    "deepspeed_tpu.compression.quantize_activation in the model"
+                )
         log_dist(
             "compression initialized: "
             f"wq={manager.weight_quant.enabled} "

@@ -45,6 +45,7 @@ class InferenceEngineV2:
         block_size: int = 32,
         max_seq_len: Optional[int] = None,
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048, 4096),
+        prefill_budget: Optional[int] = None,
         seed: int = 0,
     ):
         self.params = params
@@ -54,6 +55,12 @@ class InferenceEngineV2:
         self.max_pages = -(-self.max_seq_len // block_size)
         self.mgr = StateManager(num_blocks, block_size, max_seqs)
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
+        # SplitFuse-style token budget: multiple prompts share one prefill
+        # dispatch as long as their total length fits the budget (clamped to
+        # the largest bucket — a pack must fit one compiled dispatch)
+        self.prefill_budget = min(
+            prefill_budget or self.prefill_buckets[-1], self.prefill_buckets[-1]
+        )
         self.kv = init_paged_cache(
             cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd,
             dtype=cfg.dtype,
@@ -69,12 +76,18 @@ class InferenceEngineV2:
         def prefill_impl(params, tokens, length, blocks, kv):
             return model_runner.prefill(params, cfg_, tokens, length, blocks, kv)
 
+        def packed_impl(params, tokens, seg, pos, page_idx, page_off, last_idx, kv):
+            return model_runner.prefill_packed(
+                params, cfg_, tokens, seg, pos, page_idx, page_off, last_idx, kv
+            )
+
         def decode_impl(params, tokens, seq_lens, block_tables, active, kv):
             return model_runner.decode_step(
                 params, cfg_, tokens, seq_lens, block_tables, active, kv
             )
 
         self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(4,))
+        self._packed_prefill_jit = jax.jit(packed_impl, donate_argnums=(7,))
         self._decode_jit = jax.jit(decode_impl, donate_argnums=(5,))
 
     # -- scheduling queries (reference engine_v2.py:158/:184) --------------
@@ -113,33 +126,81 @@ class InferenceEngineV2:
         token_lists: Sequence[Sequence[int]],
         sampling: SamplingParams = SamplingParams(),
     ) -> Dict[int, int]:
-        """Admit new sequences, run their prefills, return {uid: first_token}."""
-        out = {}
+        """Admit new sequences and prefill them, returning {uid: first_token}.
+
+        Prompts are packed into shared dispatches under ``prefill_budget``
+        tokens (SplitFuse-style; reference ragged_wrapper atoms) — N short
+        prompts cost one forward pass, not N."""
+        out: Dict[int, int] = {}
+        token_lists = [list(map(int, toks)) for toks in token_lists]
+        # validate the WHOLE request before admitting anything: a mid-loop
+        # failure must not leave earlier prompts admitted with never-written
+        # KV pages
         for uid, toks in zip(uids, token_lists):
-            toks = list(map(int, toks))
-            if not self.mgr.can_admit(len(toks)):
-                raise RuntimeError(
-                    f"cannot admit uid={uid} (len {len(toks)}): out of KV blocks/slots"
+            if len(toks) > self.prefill_buckets[-1]:
+                raise ValueError(
+                    f"prompt length {len(toks)} exceeds max bucket "
+                    f"{self.prefill_buckets[-1]}"
                 )
+        if not self.can_schedule([len(t) for t in token_lists]):
+            raise RuntimeError(
+                f"cannot admit {len(token_lists)} sequences "
+                f"({sum(len(t) for t in token_lists)} tokens): "
+                "out of KV blocks/slots"
+            )
+        admitted = []
+        for uid, toks in zip(uids, token_lists):
             seq = self.mgr.admit(uid, toks)
             self.mgr.ensure_capacity(seq, 0)
-            s_pad = _bucket(len(toks), self.prefill_buckets)
-            padded = np.zeros(s_pad, np.int32)
-            padded[: len(toks)] = toks
-            n_pages_pad = -(-s_pad // self.block_size)
-            blocks = np.full(n_pages_pad, -1, np.int32)
-            blocks[: len(seq.blocks)] = seq.blocks
-            logits, self.kv = self._prefill_jit(
-                self.params, jnp.asarray(padded), jnp.asarray(len(toks)),
-                jnp.asarray(blocks), self.kv,
-            )
-            seq.seen_tokens = len(toks)
-            self._rng, sub = jax.random.split(self._rng)
-            tok = int(sample(logits[None], sampling, sub)[0])
-            seq.tokens.append(tok)
-            self._set_block_table(seq)
-            out[uid] = tok
+            admitted.append(seq)
+
+        pack: List = []
+        pack_len = 0
+        for seq in admitted:
+            n = len(seq.tokens)
+            if pack and pack_len + n > self.prefill_budget:
+                self._run_packed_prefill(pack, sampling, out)
+                pack, pack_len = [], 0
+            pack.append(seq)
+            pack_len += n
+        if pack:
+            self._run_packed_prefill(pack, sampling, out)
         return out
+
+    def _run_packed_prefill(self, seqs, sampling, out: Dict[int, int]) -> None:
+        """One packed-prefill dispatch for ``seqs`` (model_runner.prefill_packed)."""
+        total = sum(len(s.tokens) for s in seqs)
+        t_pad = _bucket(total, self.prefill_buckets)
+        tokens = np.zeros(t_pad, np.int32)
+        seg = np.zeros(t_pad, np.int32)
+        pos = np.zeros(t_pad, np.int32)
+        page_idx = np.full(t_pad, -1, np.int32)
+        page_off = np.zeros(t_pad, np.int32)
+        last_idx = np.full(self.mgr.max_seqs, -1, np.int32)
+        cur = 0
+        for j, s in enumerate(seqs):
+            n = len(s.tokens)
+            flat = np.arange(n)
+            tokens[cur : cur + n] = s.tokens
+            seg[cur : cur + n] = j + 1
+            pos[cur : cur + n] = flat
+            page_idx[cur : cur + n] = np.asarray(s.blocks)[flat // self.block_size]
+            page_off[cur : cur + n] = flat % self.block_size
+            last_idx[j] = cur + n - 1
+            cur += n
+        logits, self.kv = self._packed_prefill_jit(
+            self.params, jnp.asarray(tokens), jnp.asarray(seg), jnp.asarray(pos),
+            jnp.asarray(page_idx), jnp.asarray(page_off), jnp.asarray(last_idx),
+            self.kv,
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        next_tokens = np.asarray(sample(logits, sampling, sub))
+        for j, s in enumerate(seqs):
+            tok = int(next_tokens[j])
+            s.seen_tokens = len(s.tokens)
+            s.tokens.append(tok)
+            self._set_block_table(s)
+            out[s.uid] = tok
 
     def _set_block_table(self, seq) -> None:
         row = np.full(self.max_pages, -1, np.int32)

@@ -103,6 +103,68 @@ def prefill(
     return logits.astype(jnp.float32), (new_ck, new_cv)
 
 
+def prefill_packed(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [T] int32 — multiple prompts packed back-to-back
+    segment_ids: jnp.ndarray,  # [T] int32 — 1-based per prompt, 0 = padding
+    positions: jnp.ndarray,  # [T] int32 — per-token position within its prompt
+    page_idx: jnp.ndarray,  # [T] int32 — destination page per token (-1 pad)
+    page_off: jnp.ndarray,  # [T] int32 — destination row within the page
+    last_idx: jnp.ndarray,  # [N] int32 — buffer index of each prompt's last token (-1 pad)
+    kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+):
+    """Batched multi-prompt prefill under one token budget (the Dynamic
+    SplitFuse-shaped dispatch; reference ``inference/v2/ragged/
+    ragged_wrapper.py`` builds the same packed view as 'atoms').
+
+    All prompts share one dense causal pass; cross-prompt attention is
+    blocked by ``segment_ids`` masking.  Each token's KV row scatters
+    straight to its page.  Returns (logits [N, vocab], new caches).
+    """
+    t = tokens.shape[0]
+    x = params["embed"]["embedding"][tokens][None].astype(cfg.dtype)  # [1,T,d]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"]["embedding"][
+            jnp.clip(positions, 0, cfg.max_seq_len - 1)
+        ][None].astype(cfg.dtype)
+    ck, cv = kv_cache
+    nb = ck.shape[1]
+    # padding tokens scatter out of bounds and are dropped
+    safe_page = jnp.where(page_idx >= 0, page_idx, nb)
+    seg = segment_ids[None]  # [1, T]
+    pos2 = positions[None]
+    new_ck, new_cv = ck, cv
+    for l in range(cfg.num_layers):
+        lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(lw["attn"], h, cfg)
+        if cfg.position == "rope":
+            q = rope(q, pos2, cfg.rope_theta)
+            k = rope(k, pos2, cfg.rope_theta)
+        new_ck = new_ck.at[l, safe_page, page_off].set(
+            k[0].astype(new_ck.dtype), mode="drop"
+        )
+        new_cv = new_cv.at[l, safe_page, page_off].set(
+            v[0].astype(new_cv.dtype), mode="drop"
+        )
+        # packed order == position order within each segment, so causal
+        # masking by buffer index + segment masking is exact
+        attn = dot_product_attention(
+            q, k, v, causal=True, segment_ids=seg,
+            logits_soft_cap=cfg.logits_soft_cap,
+        )
+        attn = attn.reshape(1, t, -1) @ lw["attn"]["wo"]
+        x = x + attn.astype(x.dtype)
+        h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
+    logits = last @ head_kernel(params, cfg)  # [N, v]
+    return logits.astype(jnp.float32), (new_ck, new_cv)
+
+
 def decode_step(
     params: Params,
     cfg: TransformerConfig,

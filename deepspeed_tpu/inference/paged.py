@@ -71,7 +71,29 @@ def paged_attention_decode(
     block_table [B, P]; seq_lens [B] (length INCLUDING the current token).
     ``logits_soft_cap`` applies cap*tanh(logits/cap) before masking, matching
     prefill's ``dot_product_attention`` (gemma-2 style).  Returns [B, hq, hd].
+
+    Dispatches to the Pallas kernel (ops/pallas/paged_attention.py) on TPU —
+    per-sequence page routing + length-bounded work; this jnp gather body is
+    the fallback and ground truth (it reads all ``max_pages`` densely).
     """
+    from ..ops.pallas import on_tpu
+    from ..ops.pallas import paged_attention as pk
+
+    if (on_tpu() or pk._INTERPRET) and pk.supports(q, cache_k_layer, logits_soft_cap):
+        return pk.paged_attention_decode_kernel(
+            q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=scale
+        )
+    return _paged_attention_decode_dense(
+        q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=scale,
+        logits_soft_cap=logits_soft_cap,
+    )
+
+
+def _paged_attention_decode_dense(
+    q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
+    logits_soft_cap=None,
+):
+    """jnp reference body: gathers every table entry (O(max_pages))."""
     b, hq, hd = q.shape
     nb, bs, hkv, _ = cache_k_layer.shape
     p = block_table.shape[1]

@@ -255,6 +255,14 @@ class DeepSpeedTpuEngine:
                 "sparse_pruning": cc.sparse_pruning,
             })
             if manager.any_weight_transform:
+                if self._onebit or self._zeropp_vag is not None:
+                    from ..config.config import ConfigError
+
+                    raise ConfigError(
+                        "compression_training is not supported with 1-bit "
+                        "optimizers or ZeRO++ quantized collectives (their "
+                        "steps bypass the weight transform)"
+                    )
                 # weight-side transforms run in the step; activation quant is
                 # wired into the model forward by initialize()
                 self._compression = manager
@@ -577,10 +585,10 @@ class DeepSpeedTpuEngine:
         gas = cfg.gradient_accumulation_steps
         clip = cfg.gradient_clipping
 
-        def grad_step(params, batch_, rng):
+        def grad_step(params, batch_, rng, step):
             def one(p, micro, r):
                 loss, grads = self._micro_value_and_grad(
-                    p, micro, r, jnp.asarray(1.0, jnp.float32)
+                    p, micro, r, jnp.asarray(1.0, jnp.float32), step
                 )
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads
@@ -615,6 +623,7 @@ class DeepSpeedTpuEngine:
                 self.param_shardings,
                 self.batch_sharding(batch, batch_dim=1),
                 None,
+                self._scalar_sharding,
             ),
             out_shardings=(
                 self._scalar_sharding,
@@ -628,7 +637,7 @@ class DeepSpeedTpuEngine:
         )
 
         def call(state: TrainState, batch_, rng):
-            loss, grads, gnorm = jit_grad(state.params, batch_, rng)
+            loss, grads, gnorm = jit_grad(state.params, batch_, rng, state.step)
             gn = float(gnorm)
             coef = min(1.0, clip / (gn + 1e-6)) if clip and clip > 0 else 1.0
             lr = float(self.lr_schedule_fn(state.step))

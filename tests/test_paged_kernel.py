@@ -1,0 +1,151 @@
+"""Pallas paged-attention kernel + packed prefill tests (VERDICT r3 item 5).
+
+Reference: inference/v2/kernels/ragged_ops (blocked attention),
+ragged/ragged_wrapper.py (packed atom building).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.paged import (
+    _paged_attention_decode_dense,
+    init_paged_cache,
+)
+from deepspeed_tpu.ops.pallas import paged_attention as pk
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    pk.set_interpret(True)
+    yield
+    pk.set_interpret(False)
+
+
+def _setup(B=4, hq=8, hkv=2, hd=64, nb=32, bs=16, P=6, lens=(5, 16, 33, 90), dtype=jnp.float32):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, hq, hd)), dtype)
+    ck = jnp.asarray(rng.normal(size=(nb, bs, hkv, hd)), dtype)
+    cv = jnp.asarray(rng.normal(size=(nb, bs, hkv, hd)), dtype)
+    table = np.full((B, P), -1, np.int32)
+    nxt = 1
+    for b in range(B):
+        for i in range(-(-int(lens[b]) // bs)):
+            table[b, i] = nxt % nb
+            nxt += 1
+    return q, ck, cv, jnp.asarray(table), jnp.asarray(lens, jnp.int32)
+
+
+def test_kernel_parity_vs_dense_gather():
+    q, ck, cv, table, lens = _setup()
+    out_k = pk.paged_attention_decode_kernel(q, ck, cv, table, lens)
+    out_d = _paged_attention_decode_dense(q, ck, cv, table, lens)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d), atol=2e-5)
+
+
+def test_kernel_parity_gqa_and_mha():
+    for hq, hkv in ((8, 8), (8, 2), (4, 1)):
+        q, ck, cv, table, lens = _setup(hq=hq, hkv=hkv)
+        out_k = pk.paged_attention_decode_kernel(q, ck, cv, table, lens)
+        out_d = _paged_attention_decode_dense(q, ck, cv, table, lens)
+        np.testing.assert_allclose(
+            np.asarray(out_k), np.asarray(out_d), atol=2e-5,
+            err_msg=f"hq={hq} hkv={hkv}",
+        )
+
+
+def test_kernel_ignores_garbage_in_dead_pages():
+    """Pages past a sequence's length may hold other sequences' data: the
+    kernel must never read them (it routes only live table entries)."""
+    q, ck, cv, table, lens = _setup(lens=(5, 16, 33, 90))
+    out1 = pk.paged_attention_decode_kernel(q, ck, cv, table, lens)
+    # poison every block NOT referenced by live pages
+    live = set()
+    bs = ck.shape[1]
+    for b in range(table.shape[0]):
+        for i in range(-(-int(lens[b]) // bs)):
+            live.add(int(table[b, i]))
+    dead = [blk for blk in range(ck.shape[0]) if blk not in live]
+    ck2 = ck.at[jnp.asarray(dead)].set(1e4)
+    cv2 = cv.at[jnp.asarray(dead)].set(1e4)
+    out2 = pk.paged_attention_decode_kernel(q, ck2, cv2, table, lens)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=2e-5)
+
+
+def test_dispatch_routes_to_kernel_in_interpret_mode():
+    from deepspeed_tpu.inference.paged import paged_attention_decode
+
+    q, ck, cv, table, lens = _setup()
+    out = paged_attention_decode(q, ck, cv, table, lens)
+    ref = _paged_attention_decode_dense(q, ck, cv, table, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# packed multi-prompt prefill
+# ---------------------------------------------------------------------------
+def _engine(**kw):
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    cfg = get_preset("tiny", max_seq_len=128).replace(dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), params)
+    return InferenceEngineV2(
+        params, cfg, max_seqs=4, num_blocks=64, block_size=8, **kw
+    ), cfg
+
+
+def test_packed_prefill_matches_sequential():
+    """N prompts in ONE packed dispatch produce the same first tokens and
+    the same decode continuations as one-prefill-per-prompt."""
+    rng = np.random.default_rng(1)
+    prompts = [list(map(int, rng.integers(1, 250, n))) for n in (5, 11, 17)]
+
+    packed, _ = _engine(prefill_budget=128)
+    first_packed = packed.put([1, 2, 3], prompts)
+    assert len(packed._last_pack_sizes) if hasattr(packed, "_last_pack_sizes") else True
+
+    seq_engine, _ = _engine(prefill_budget=1)  # budget 1 forces one-per-pack
+    first_seq = seq_engine.put([1, 2, 3], prompts)
+    assert first_packed == first_seq
+
+    # decode continuations agree too (same KV contents)
+    for _ in range(3):
+        a = packed.step()
+        b = seq_engine.step()
+        assert a == b
+
+
+def test_packed_prefill_one_dispatch_for_many_prompts():
+    engine, _ = _engine(prefill_budget=128)
+    calls = []
+    orig = engine._run_packed_prefill
+
+    def counting(seqs, sampling, out):
+        calls.append(len(seqs))
+        return orig(seqs, sampling, out)
+
+    engine._run_packed_prefill = counting
+    rng = np.random.default_rng(2)
+    prompts = [list(map(int, rng.integers(1, 250, n))) for n in (6, 9, 12)]
+    engine.put([1, 2, 3], prompts)
+    assert calls == [3]  # all three prompts shared one dispatch
+
+
+def test_packed_prefill_splits_at_budget():
+    engine, _ = _engine(prefill_budget=24)
+    calls = []
+    orig = engine._run_packed_prefill
+
+    def counting(seqs, sampling, out):
+        calls.append(sum(len(s.tokens) for s in seqs))
+        return orig(seqs, sampling, out)
+
+    engine._run_packed_prefill = counting
+    rng = np.random.default_rng(3)
+    prompts = [list(map(int, rng.integers(1, 250, n))) for n in (10, 10, 10)]
+    engine.put([1, 2, 3], prompts)
+    assert len(calls) == 2  # 20 + 10: budget 24 splits after two prompts
+    assert all(c <= 24 for c in calls)
