@@ -126,6 +126,12 @@ def initialize(
     if mesh is None:
         axes = _mesh_axes_from_config(cfg, jax.device_count(), cfg.zero_optimization.stage)
         mesh = initialize_mesh(**axes)
+    # install the ambient mesh: activation-sharding constraints and the
+    # pipelined executor read it (parallel/sharding.py) — users shouldn't
+    # have to call set_current_mesh by hand
+    from .parallel.sharding import set_current_mesh
+
+    set_current_mesh(mesh.mesh)
 
     if params is None:
         if model is None:

@@ -1,0 +1,2 @@
+"""Autotuning: in-process config search (reference deepspeed/autotuning/)."""
+from .autotuner import Autotuner, Experiment, autotune_model  # noqa: F401

@@ -1,0 +1,226 @@
+"""Autotuner: search micro-batch x remat policy x ZeRO stage x mesh shape.
+
+Reference: ``deepspeed/autotuning/autotuner.py:663`` — it launches short
+experiment *processes* through the launcher (tuner strategies in
+``autotuning/tuner/``, resource manager in ``scheduler.py``) because torch
+experiments are expensive to set up.  On TPU an experiment is one jit
+compile + a few steps in-process, so the tuner is a simple in-process loop:
+
+1. model-info pass: param count -> memory model prunes infeasible
+   candidates before any compile (the reference's ``model_info`` profile
+   run);
+2. for each surviving candidate: build an engine, time ``steps`` fused
+   steps, tear down;
+3. rank by tokens/sec (the reference's default ``throughput`` metric) and
+   return the best full config dict.
+
+Failures (OOM, compiler rejection) mark a candidate infeasible and the
+search continues — same contract as the reference's failed experiments.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+TUNING_METRICS = ("throughput", "latency")
+
+
+@dataclass
+class Experiment:
+    micro_batch: int
+    remat: str
+    zero_stage: int
+    mesh_axes: Dict[str, int]
+    step_time: Optional[float] = None
+    tokens_per_sec: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def feasible(self) -> bool:
+        return self.error is None and self.step_time is not None
+
+    def describe(self) -> str:
+        return (
+            f"micro={self.micro_batch} remat={self.remat} "
+            f"zero={self.zero_stage} mesh={self.mesh_axes}"
+        )
+
+
+@dataclass
+class Autotuner:
+    """In-process config search for one model + chip budget.
+
+    ``model_factory(remat) -> model adapter`` builds the model with a remat
+    policy (models are cheap shells; params re-init per trial).
+    """
+
+    model_factory: Any
+    base_config: Dict[str, Any]
+    seq_len: int
+    micro_batches: Sequence[int] = (1, 2, 4, 8)
+    remat_policies: Sequence[str] = ("none", "selective", "full")
+    zero_stages: Sequence[int] = (1,)
+    mesh_candidates: Optional[Sequence[Dict[str, int]]] = None
+    steps: int = 3
+    metric: str = "throughput"
+    max_trials: Optional[int] = None
+    device_memory_bytes: Optional[int] = None
+    experiments: List[Experiment] = field(default_factory=list)
+
+    # -- memory model (model-info pruning pass) -----------------------------
+    def _estimate_bytes(self, n_params: int, micro: int, remat: str,
+                        zero_stage: int, mesh: Dict[str, int]) -> int:
+        shard = max(mesh.get("fsdp", 1), 1)
+        state = n_params * 4 * 3 / (shard if zero_stage >= 1 else 1)  # fp32 master+m+v
+        compute = n_params * 2 / (shard if zero_stage >= 3 else 1)  # bf16 copy
+        model = self.model_factory("none")
+        cfg = getattr(model, "cfg", None)
+        d = getattr(cfg, "hidden_size", 1024)
+        L = getattr(cfg, "num_layers", 24)
+        f = getattr(cfg, "intermediate_size", 4 * d)
+        v = getattr(cfg, "vocab_size", 32000)
+        tok = micro * self.seq_len
+        act_per_layer = {
+            "none": tok * (2 * f + 6 * d) * 2,
+            "selective": tok * 5 * d * 2,
+            "full": tok * d * 2,
+        }.get(remat, tok * 5 * d * 2)
+        acts = L * act_per_layer + tok * v * 6  # + logits fwd/bwd fp32
+        return int(state + compute + acts)
+
+    def _candidates(self):
+        meshes = self.mesh_candidates or [{}]
+        for mesh, stage, remat, micro in itertools.product(
+            meshes, self.zero_stages, self.remat_policies, self.micro_batches
+        ):
+            yield Experiment(
+                micro_batch=micro, remat=remat, zero_stage=stage,
+                mesh_axes=dict(mesh),
+            )
+
+    # -- one experiment ------------------------------------------------------
+    def _run_experiment(self, exp: Experiment) -> None:
+        import gc
+
+        import jax
+
+        import deepspeed_tpu as ds
+
+        config = dict(self.base_config)
+        config["train_micro_batch_size_per_gpu"] = exp.micro_batch
+        config.setdefault("steps_per_print", 1_000_000)
+        zo = dict(config.get("zero_optimization", {}))
+        zo["stage"] = exp.zero_stage
+        config["zero_optimization"] = zo
+        engine = None
+        try:
+            model = self.model_factory(exp.remat)
+            mesh = ds.initialize_mesh(**exp.mesh_axes) if exp.mesh_axes else None
+            engine, _, _, _ = ds.initialize(model=model, config=config, mesh=mesh)
+            vocab = getattr(getattr(model, "cfg", None), "vocab_size", 1000)
+            rng = np.random.default_rng(0)
+            dp = engine.grid.dp_world_size
+            batch = {
+                "input_ids": rng.integers(
+                    0, vocab, (1, exp.micro_batch * dp, self.seq_len + 1)
+                ).astype(np.int32)
+            }
+            loss = engine.train_batch(batch)  # compile + warmup
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                loss = engine.train_batch(batch)
+            float(loss)
+            exp.step_time = (time.perf_counter() - t0) / self.steps
+            exp.tokens_per_sec = exp.micro_batch * dp * self.seq_len / exp.step_time
+        except Exception as e:  # infeasible candidate — record and continue
+            exp.error = f"{type(e).__name__}: {str(e)[:200]}"
+        finally:
+            del engine
+            gc.collect()
+
+    # -- the search ----------------------------------------------------------
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], List[Experiment]]:
+        """Returns (best_config_dict or None, all experiments)."""
+        import jax
+
+        if self.metric not in TUNING_METRICS:
+            raise ValueError(f"metric must be one of {TUNING_METRICS}")
+        model = self.model_factory("none")
+        n_params = getattr(model, "param_count", None)
+        hbm = self.device_memory_bytes
+        if hbm is None:
+            from ..accelerator import get_accelerator
+
+            try:
+                hbm = get_accelerator().total_memory()
+            except Exception:
+                hbm = None
+
+        trials = 0
+        for exp in self._candidates():
+            if self.max_trials is not None and trials >= self.max_trials:
+                break
+            if hbm and n_params:
+                est = self._estimate_bytes(
+                    n_params, exp.micro_batch, exp.remat, exp.zero_stage,
+                    exp.mesh_axes,
+                )
+                if est > hbm:
+                    exp.error = f"pruned: est {est/1e9:.1f}GB > HBM {hbm/1e9:.1f}GB"
+                    self.experiments.append(exp)
+                    continue
+            self._run_experiment(exp)
+            self.experiments.append(exp)
+            trials += 1
+            status = (
+                f"{exp.tokens_per_sec:,.0f} tok/s"
+                if exp.feasible else f"FAILED ({exp.error})"
+            )
+            log_dist(f"autotune: {exp.describe()} -> {status}")
+
+        feasible = [e for e in self.experiments if e.feasible]
+        if not feasible:
+            return None, self.experiments
+        if self.metric == "throughput":
+            best = max(feasible, key=lambda e: e.tokens_per_sec)
+        else:
+            best = min(feasible, key=lambda e: e.step_time)
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = best.micro_batch
+        zo = dict(cfg.get("zero_optimization", {}))
+        zo["stage"] = best.zero_stage
+        cfg["zero_optimization"] = zo
+        cfg["_autotune"] = {
+            "remat": best.remat,
+            "mesh": best.mesh_axes,
+            "tokens_per_sec": best.tokens_per_sec,
+            "step_time": best.step_time,
+        }
+        log_dist(f"autotune: BEST {best.describe()} @ {best.tokens_per_sec:,.0f} tok/s")
+        return cfg, self.experiments
+
+
+def autotune_model(
+    preset: str,
+    seq_len: int,
+    base_config: Optional[Dict[str, Any]] = None,
+    **kw,
+) -> Tuple[Optional[Dict[str, Any]], List[Experiment]]:
+    """Convenience entry: tune a named preset (models/presets.py)."""
+    from ..models import CausalLM, get_preset
+
+    def factory(remat: str):
+        return CausalLM(get_preset(preset, remat=remat, max_seq_len=seq_len))
+
+    base = base_config or {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+    }
+    return Autotuner(factory, base, seq_len, **kw).tune()

@@ -274,6 +274,14 @@ def init_compression(engine_or_params, deepspeed_config: Dict, teacher_model=Non
     target = engine_or_params
     if hasattr(target, "_micro_value_and_grad"):  # engine
         if manager.any_weight_transform:
+            if getattr(target, "_onebit", False) or getattr(
+                target, "_zeropp_vag", None
+            ) is not None:
+                raise ValueError(
+                    "compression_training is not supported with 1-bit "
+                    "optimizers or ZeRO++ quantized collectives (their steps "
+                    "bypass the weight transform)"
+                )
             target._compression = manager
             target._train_step = None  # force re-trace with the transform inside
         if manager.act_quant.enabled:

@@ -73,9 +73,6 @@ class InferenceEngineV2:
         # every weight into the HLO as a constant (huge programs, no donation)
         cfg_ = self.cfg
 
-        def prefill_impl(params, tokens, length, blocks, kv):
-            return model_runner.prefill(params, cfg_, tokens, length, blocks, kv)
-
         def packed_impl(params, tokens, seg, pos, page_idx, page_off, last_idx, kv):
             return model_runner.prefill_packed(
                 params, cfg_, tokens, seg, pos, page_idx, page_off, last_idx, kv
@@ -86,7 +83,6 @@ class InferenceEngineV2:
                 params, cfg_, tokens, seq_lens, block_tables, active, kv
             )
 
-        self._prefill_jit = jax.jit(prefill_impl, donate_argnums=(4,))
         self._packed_prefill_jit = jax.jit(packed_impl, donate_argnums=(7,))
         self._decode_jit = jax.jit(decode_impl, donate_argnums=(5,))
 

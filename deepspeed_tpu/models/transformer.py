@@ -374,8 +374,12 @@ def forward(
     x = shard_activation(x, ACT_SPEC)
 
     if stack_apply is not None:
-        x = stack_apply(params["layers"], x, positions)
-        new_caches, aux_loss = None, jnp.asarray(0.0, jnp.float32)
+        out = stack_apply(params["layers"], x, positions)
+        # pipelined stacks return (x, moe_aux_loss); plain ones just x
+        x, aux_loss = out if isinstance(out, tuple) else (
+            out, jnp.asarray(0.0, jnp.float32)
+        )
+        new_caches = None
     else:
         def body(carry, scanned):
             h = carry

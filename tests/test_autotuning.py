@@ -1,0 +1,90 @@
+"""Autotuner tests (reference: tests/unit/autotuning/ — experiment
+generation, pruning, best-config selection)."""
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import Autotuner, autotune_model
+from deepspeed_tpu.models import CausalLM, get_preset
+
+
+def _factory(remat):
+    return CausalLM(get_preset("tiny", remat=remat, max_seq_len=32))
+
+
+BASE = {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+
+
+def test_autotune_returns_best_feasible_config():
+    tuner = Autotuner(
+        _factory, BASE, seq_len=32,
+        micro_batches=(1, 2),
+        remat_policies=("none", "full"),
+        zero_stages=(1,),
+        mesh_candidates=[{"data": 8}],
+        steps=2,
+        device_memory_bytes=None,
+    )
+    best, experiments = tuner.tune()
+    assert best is not None
+    feasible = [e for e in experiments if e.feasible]
+    assert feasible, [e.error for e in experiments]
+    assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert best["_autotune"]["remat"] in ("none", "full")
+    # best really is the throughput argmax
+    top = max(feasible, key=lambda e: e.tokens_per_sec)
+    assert best["_autotune"]["tokens_per_sec"] == top.tokens_per_sec
+
+
+def test_autotune_best_config_trains():
+    best, _ = autotune_model(
+        "tiny", seq_len=32, base_config=BASE,
+        micro_batches=(2,), remat_policies=("none",), zero_stages=(1,),
+        mesh_candidates=[{"fsdp": 8}], steps=1,
+    )
+    assert best is not None
+    meta = best.pop("_autotune")
+    model = CausalLM(get_preset("tiny", remat=meta["remat"], max_seq_len=32))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=best,
+        mesh=deepspeed_tpu.initialize_mesh(**(meta["mesh"] or {"fsdp": 8})),
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (16, 33)).astype(np.int32)}
+    assert np.isfinite(float(engine.train_batch(batch)))
+
+
+def test_autotune_memory_pruning():
+    tuner = Autotuner(
+        _factory, BASE, seq_len=32,
+        micro_batches=(1, 1024),
+        remat_policies=("none",),
+        zero_stages=(1,),
+        mesh_candidates=[{"data": 8}],
+        steps=1,
+        device_memory_bytes=50_000_000,  # 50MB: the huge micro must be pruned
+    )
+    best, experiments = tuner.tune()
+    pruned = [e for e in experiments if e.error and e.error.startswith("pruned")]
+    assert pruned and all(e.micro_batch == 1024 for e in pruned)
+    assert best is not None and best["train_micro_batch_size_per_gpu"] == 1
+
+
+def test_autotune_infeasible_candidates_dont_abort():
+    def bad_factory(remat):
+        if remat == "selective":
+            raise RuntimeError("boom")
+        return _factory(remat)
+
+    tuner = Autotuner(
+        bad_factory, BASE, seq_len=32,
+        micro_batches=(1,),
+        remat_policies=("selective", "none"),
+        zero_stages=(1,),
+        mesh_candidates=[{"data": 8}],
+        steps=1,
+    )
+    best, experiments = tuner.tune()
+    assert best is not None and best["_autotune"]["remat"] == "none"
+    errs = [e for e in experiments if e.error]
+    assert any("boom" in e.error for e in errs)

@@ -195,3 +195,108 @@ def test_pipelined_trains_end_to_end(stage_mesh):
     for _ in range(15):
         loss = float(engine.train_batch(batch))
     assert loss < first * 0.8, (first, loss)
+
+
+# ---------------------------------------------------------------------------
+# r3: no emit-stream gather, MoE composition, aux parity
+# ---------------------------------------------------------------------------
+def test_pipeline_apply_with_aux_matches_sequential(stage_mesh):
+    """with_aux accumulates per-layer scalars exactly once per microbatch
+    (bubble ticks must not contribute)."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(4, 8, 8)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+
+    def layer_fn(h, lw):
+        h = jnp.tanh(h @ lw)
+        # per-layer aux with MEAN-over-rows semantics (the MoE gating
+        # contract: cross-DP combination is pmean)
+        return h, jnp.mean(h * h)
+
+    out, aux = pipeline_apply(w, x, layer_fn, num_stages=4, num_micro=4,
+                              with_aux=True)
+
+    # sequential reference over microbatches
+    def seq(x):
+        aux = 0.0
+        for m in range(4):
+            h = x[m * 2:(m + 1) * 2]
+            for l in range(4):
+                h = jnp.tanh(h @ w[l])
+                aux = aux + jnp.mean(h * h)
+            x = x.at[m * 2:(m + 1) * 2].set(h)
+        # dense semantics: each layer's mean over the WHOLE batch = average
+        # of its per-microbatch means
+        return x, aux / 4
+
+    ref_out, ref_aux = seq(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+def test_pipelined_moe_composes_and_trains(stage_mesh):
+    """PP + MoE: the r2 restriction is lifted — a Mixtral-style block stack
+    trains under the pipelined executor with a live aux loss."""
+    import deepspeed_tpu
+
+    cfg = get_preset("tiny_moe", max_seq_len=32).replace(
+        num_layers=4, attn_impl="reference"
+    )
+    model = PipelinedCausalLM(cfg, num_stages=4, num_micro=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 0},
+        },
+        mesh=stage_mesh,
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (4, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    # aux parity vs the dense (non-pipelined) model on identical params
+    dense = CausalLM(cfg)
+    params = engine.state.params
+    dense_loss = float(dense.loss_fn(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params),
+        {"input_ids": jnp.asarray(batch["input_ids"])},
+    ))
+    piped_loss = float(model.loss_fn(
+        jax.tree_util.tree_map(lambda x: x.astype(cfg.dtype), params),
+        {"input_ids": jnp.asarray(batch["input_ids"])},
+    ))
+    # not exact: gating capacity is computed per microbatch in the pipeline
+    # (64 tokens) vs once over the full batch in the dense path (128 tokens),
+    # so token dropping differs — same inherent gap as the reference's
+    # per-micro-batch MOELayer capacity. Exact aux math is covered by
+    # test_pipeline_apply_with_aux_matches_sequential.
+    assert abs(dense_loss - piped_loss) < 0.2, (dense_loss, piped_loss)
+
+
+def test_pipeline_no_emit_stream_memory(stage_mesh):
+    """The compiled pipelined step must not allocate an [S*T, mb, ...]
+    stacked emit buffer: output-related temp memory stays O(batch)."""
+    rng = np.random.default_rng(1)
+    S, M, mb, d = 4, 8, 4, 64
+    B = M * mb
+    w = jnp.asarray(rng.normal(size=(S, d, d)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    def loss(w, x):
+        return jnp.sum(pipeline_apply(w, x, layer_fn, S, M) ** 2)
+
+    compiled = jax.jit(jax.grad(loss)).lower(w, x).compile()
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    if temp is None:
+        pytest.skip("backend lacks memory analysis")
+    # generous bound: params + a handful of [B, d] buffers + T tick
+    # residuals; the old emit stream alone was S*T*mb*d floats on top
+    budget = 4 * (S * d * d + (2 * (M + S) + 8 * S) * mb * d)
+    assert temp <= budget, (temp, budget)
