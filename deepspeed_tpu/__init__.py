@@ -13,7 +13,7 @@ from typing import Any, Callable, Optional, Tuple
 __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
-from .config.config import Config, parse_config
+from .config.config import Config, ConfigError, parse_config
 from .parallel.topology import Grid, MeshSpec, initialize_mesh
 from .runtime.dataloader import DeepSpeedTpuDataLoader, RepeatingLoader
 from .runtime.engine import DeepSpeedTpuEngine, TrainState
@@ -22,7 +22,9 @@ from .utils.logging import log_dist, logger
 
 def _mesh_axes_from_config(cfg: Config, world: int, zero_stage: int):
     """Resolve mesh axis sizes: explicit sizes win; leftover devices go to
-    ``fsdp`` when ZeRO>=1 (partitioning wants the fsdp axis) else ``data``."""
+    ``fsdp`` when ZeRO>=1 (partitioning wants the fsdp axis) else ``data``.
+    ``zero_hpz_partition_size`` / ``mics_shard_size`` factor the fsdp extent
+    into (fsdp, sub) so secondary partitions ride the inner ``sub`` axis."""
     m = cfg.mesh
     fixed = {}
     for ax in ("model", "seq", "expert", "stage"):
@@ -47,6 +49,17 @@ def _mesh_axes_from_config(cfg: Config, world: int, zero_stage: int):
         fixed["data"] = world // used
     elif "fsdp" not in fixed:
         fixed["fsdp"] = world // used
+    zo = cfg.zero_optimization
+    group = max(zo.zero_hpz_partition_size, zo.mics_shard_size)
+    if group > 1:
+        total = fixed.get("fsdp", 1)
+        if total % group:
+            raise ConfigError(
+                f"hpZ/MiCS group size {group} does not divide the fsdp "
+                f"extent {total}"
+            )
+        fixed["fsdp"] = total // group
+        fixed["sub"] = group
     return fixed
 
 
@@ -206,6 +219,9 @@ def initialize(
     cfg.finalize(mesh.dp_world_size)
     comm.comm.configure(cfg.comms_logger)
 
+    trainable_mask = None
+    if model is not None and hasattr(model, "trainable_mask"):
+        trainable_mask = model.trainable_mask(params)
     engine = DeepSpeedTpuEngine(
         loss_fn=loss_fn,
         params=params,
@@ -213,6 +229,7 @@ def initialize(
         grid=mesh,
         tp_rules=tp_rules,
         eval_fn=eval_fn,
+        trainable_mask=trainable_mask,
     )
     from .monitor.monitor import MonitorMaster
 

@@ -1,0 +1,111 @@
+"""Pluggable checkpoint engines: sync + async.
+
+Reference: ``runtime/checkpoint_engine/checkpoint_engine.py:10
+CheckpointEngine`` (create/save/load/commit ABC), ``TorchCheckpointEngine``
+(sync), ``NebulaCheckpointEngine`` (async service).  Orbax already has an
+async tier; this module shapes it into the reference's engine contract so
+``save_checkpoint`` stays engine-agnostic and ``latest`` is only committed
+once the async write has durably finished (the reference's commit() step).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+from ..utils.logging import log_dist
+
+
+class CheckpointEngine:
+    """Reference-shaped interface (checkpoint_engine.py:10)."""
+
+    def create(self, tag: str) -> None:  # logging/bookkeeping hook
+        pass
+
+    def save(self, state: Any, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, item: Any, restore_args: Any) -> Any:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+    def wait(self) -> None:
+        pass
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Synchronous orbax PyTree checkpointing (the TorchCheckpointEngine
+    analogue)."""
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def save(self, state, path):
+        self._ckptr.save(path, state, force=True)
+
+    def load(self, path, item, restore_args):
+        return self._ckptr.restore(path, item=item, restore_args=restore_args)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Async background checkpointing (the NebulaCheckpointEngine analogue):
+    ``save`` returns once the device->host copy is staged; the serialization
+    runs on a background thread.  ``commit`` blocks until durable, so the
+    ``latest`` tag never points at a partial checkpoint."""
+
+    def __init__(self):
+        import atexit
+
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending: Optional[str] = None
+        self._on_commit: Optional[Callable[[], None]] = None
+        # a run's FINAL save must still commit its 'latest' tag even if the
+        # user never awaits it explicitly
+        atexit.register(self.wait)
+
+    def save(self, state, path):
+        self.wait()  # one in-flight save at a time
+        self._ckptr.save(path, state, force=True)
+        self._pending = path
+
+    def load(self, path, item, restore_args):
+        self.wait()
+        return self._ckptr.restore(path, item=item, restore_args=restore_args)
+
+    def set_commit_callback(self, fn: Callable[[], None]) -> None:
+        self._on_commit = fn
+
+    def wait(self) -> None:
+        if self._pending is not None:
+            self._ckptr.wait_until_finished()
+            log_dist(f"async checkpoint committed: {self._pending}")
+            self._pending = None
+            if self._on_commit is not None:
+                cb, self._on_commit = self._on_commit, None
+                cb()
+
+    def commit(self, tag: str) -> bool:
+        self.wait()
+        return True
+
+    @property
+    def pending(self) -> Optional[str]:
+        return self._pending
+
+
+def get_checkpoint_engine(engine) -> CheckpointEngine:
+    """Per-engine singleton, selected by ``checkpoint.async_save``."""
+    existing = getattr(engine, "_ckpt_engine", None)
+    if existing is not None:
+        return existing
+    if engine.config.checkpoint.async_save:
+        ce: CheckpointEngine = AsyncCheckpointEngine()
+    else:
+        ce = OrbaxCheckpointEngine()
+    engine._ckpt_engine = ce
+    return ce

@@ -38,14 +38,14 @@ def _nvme_dir(path: str) -> str:
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state=None):
-    import orbax.checkpoint as ocp
+    from .engine import AsyncCheckpointEngine, get_checkpoint_engine
 
+    ce = get_checkpoint_engine(engine)
     tag = _tag(engine, tag)
     path = os.path.abspath(os.path.join(save_dir, tag))
     os.makedirs(path, exist_ok=True)
-    ckptr = ocp.PyTreeCheckpointer()
     state = jax.tree_util.tree_map(lambda x: x, engine.state)  # shallow copy
-    ckptr.save(os.path.join(path, "state"), state, force=True)
+    ce.save(state, os.path.join(path, "state"))
     nvme = getattr(engine, "_nvme_opt", None)
     if nvme is not None and jax.process_index() == 0:
         # NVMe tier: masters + Adam moments live in the swap pool, not the
@@ -74,9 +74,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         # filesystems (the reference guards all non-sharded files this way)
         with open(os.path.join(path, "meta.json"), "w") as fh:
             json.dump(meta, fh)
-    if jax.process_index() == 0:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
-            fh.write(tag)
+
+    def write_latest():
+        if jax.process_index() == 0:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
+                fh.write(tag)
+
+    if isinstance(ce, AsyncCheckpointEngine) and ce.pending:
+        # 'latest' must never point at a partial checkpoint: commit-time only
+        ce.set_commit_callback(write_latest)
+    else:
+        write_latest()
     log_dist(f"saved checkpoint {path}")
     return path
 
@@ -98,12 +106,15 @@ def load_checkpoint(
 ) -> Tuple[Optional[str], Dict[str, Any]]:
     import orbax.checkpoint as ocp
 
+    from .engine import get_checkpoint_engine
+
+    ce = get_checkpoint_engine(engine)
+    ce.wait()  # a pending async save must land before we read
     tag = tag or get_latest_tag(load_dir)
     if tag is None:
         log_dist(f"no checkpoint found under {load_dir}")
         return None, {}
     path = os.path.join(os.path.abspath(load_dir), tag)
-    ckptr = ocp.PyTreeCheckpointer()
     # restore with the engine's own shardings: this is what makes checkpoints
     # topology-free — a run on a different mesh supplies different shardings
     # for the same logical arrays (reference needed ds_to_universal for this)
@@ -111,7 +122,7 @@ def load_checkpoint(
         lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding, dtype=x.dtype),
         engine.state,
     )
-    state = ckptr.restore(
+    state = ce.load(
         os.path.join(path, "state"),
         item=engine.state,
         restore_args=restore_args,

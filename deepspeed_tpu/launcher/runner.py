@@ -109,6 +109,11 @@ def parse_args(argv=None):
     p.add_argument("--coordinator", default=None, help="coordinator host (default: first)")
     p.add_argument("--coordinator-port", type=int, default=DEFAULT_COORD_PORT)
     p.add_argument("--env", action="append", default=[], help="env var names to forward")
+    p.add_argument(
+        "--launcher", default="ssh",
+        choices=("ssh", "pdsh", "openmpi", "mpich", "slurm", "mvapich"),
+        help="multinode backend (reference launcher/multinode_runner.py)",
+    )
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -121,6 +126,21 @@ def main(argv=None) -> int:
         logger.info("no hostfile: launching single-process locally")
         return subprocess.call(cmd)
     hosts = filter_hosts(fetch_hostfile(args.hostfile), args.include, args.exclude)
+    if args.launcher != "ssh":
+        # scheduler-native backends synthesize ONE local launch command
+        from .multinode_runner import get_runner
+
+        env = {k: os.environ[k] for k in args.env if k in os.environ}
+        runner = get_runner(
+            args.launcher, hosts, coordinator=args.coordinator,
+            port=args.coordinator_port, env=env,
+        )
+        if not runner.backend_exists():
+            logger.error(f"launcher backend '{args.launcher}' not found on PATH")
+            return 1
+        full = runner.get_cmd(cmd)
+        logger.info(f"launching via {args.launcher}: {' '.join(full)}")
+        return subprocess.call(full)
     launches = build_host_commands(
         hosts, cmd, args.coordinator, args.coordinator_port, args.env
     )

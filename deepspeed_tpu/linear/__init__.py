@@ -1,0 +1,2 @@
+"""LoRA / OptimizedLinear (reference deepspeed/linear/)."""
+from .lora import LoRACausalLM, LoRAConfig, optimized_linear  # noqa: F401

@@ -1,0 +1,168 @@
+"""LoRA / OptimizedLinear: parameter-efficient fine-tuning.
+
+Reference: ``deepspeed/linear/optimized_linear.py:18 OptimizedLinear`` — an
+nn.Linear replacement holding a (possibly quantized, possibly sharded)
+frozen base weight plus trainable low-rank ``lora_a @ lora_b`` factors
+(``:76 LoRAOptimizedLinear``; config ``deepspeed/linear/config.py``).
+
+TPU formulation: no module surgery — a **model wrapper** adds a ``lora``
+subtree next to the frozen ``base`` params and merges
+``W + (alpha/r) * A @ B`` functionally inside the traced loss.  Freezing is
+expressed to the optimizer as a trainable mask (``optax.masked``): frozen
+leaves carry no optimizer state (the actual memory win of LoRA) and receive
+no update — the engine consumes ``model.trainable_mask``.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+DEFAULT_TARGETS = (r"layers/attn/w[qkvo]$", r"layers/(mlp|moe)/w_(gate|up|down)$")
+
+
+@dataclass
+class LoRAConfig:
+    """Mirrors the reference ``LoRAConfig`` (linear/config.py): rank, alpha,
+    target selection; ``base_weight_sharding`` is subsumed by the ZeRO plan
+    (base weights shard like any other param)."""
+
+    lora_r: int = 8
+    lora_alpha: float = 16.0
+    target_modules: Sequence[str] = DEFAULT_TARGETS
+    # store the frozen base in the compute dtype instead of fp32 masters
+    # (frozen weights need no master precision)
+    base_dtype: Any = jnp.bfloat16
+
+    @property
+    def scale(self) -> float:
+        return self.lora_alpha / self.lora_r
+
+
+def _match(path: str, patterns: Sequence[str]) -> bool:
+    return any(re.search(p, path) for p in patterns)
+
+
+def _paths_and_leaves(tree):
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        yield path, leaf
+
+
+class LoRACausalLM:
+    """Wrap any model adapter (CausalLM-shaped) with LoRA fine-tuning.
+
+    Param tree: ``{"base": <frozen inner params>, "lora": {path: {"a", "b"}}}``.
+    ``trainable_mask(params)`` marks base leaves frozen — consumed by the
+    engine's optimizer masking.
+    """
+
+    def __init__(self, inner, lora_config: Optional[LoRAConfig] = None):
+        self.inner = inner
+        self.cfg = getattr(inner, "cfg", None)
+        self.lora = lora_config or LoRAConfig()
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, rng):
+        base = self.inner.init_params(rng)
+        base = jax.tree_util.tree_map(
+            lambda x: x.astype(self.lora.base_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            base,
+        )
+        lora: Dict[str, Dict[str, jnp.ndarray]] = {}
+        keys = jax.random.split(rng, 1 + sum(1 for _ in _paths_and_leaves(base)))
+        i = 0
+        for path, leaf in _paths_and_leaves(base):
+            i += 1
+            if leaf.ndim < 2 or not _match(path, self.lora.target_modules):
+                continue
+            *lead, fan_in, fan_out = leaf.shape
+            r = self.lora.lora_r
+            # reference init: A ~ kaiming-ish small, B = 0 (adapter starts
+            # as identity)
+            a = (jax.random.normal(keys[i], (*lead, fan_in, r), jnp.float32)
+                 / jnp.sqrt(fan_in)).astype(jnp.float32)
+            b = jnp.zeros((*lead, r, fan_out), jnp.float32)
+            lora[path.replace("/", ".")] = {"a": a, "b": b}
+        if not lora:
+            raise ValueError(
+                f"no parameters matched LoRA target_modules {self.lora.target_modules}"
+            )
+        n = sum(
+            int(l.size) for g in lora.values() for l in g.values()
+        )
+        log_dist(f"LoRA: {len(lora)} adapted tensors, {n/1e6:.2f}M trainable params")
+        return {"base": base, "lora": lora}
+
+    def merge(self, params):
+        """base + scale * A @ B for adapted leaves (traced in the step)."""
+        lora = params["lora"]
+
+        def merged():
+            flat = {}
+            for path, leaf in _paths_and_leaves(params["base"]):
+                # frozen: no backward flops spent on base weight grads
+                leaf = jax.lax.stop_gradient(leaf)
+                key = path.replace("/", ".")
+                if key in lora:
+                    a = lora[key]["a"].astype(jnp.float32)
+                    b = lora[key]["b"].astype(jnp.float32)
+                    delta = (a @ b) * self.lora.scale
+                    leaf = (leaf.astype(jnp.float32) + delta).astype(leaf.dtype)
+                flat[path] = leaf
+            return flat
+
+        flat = merged()
+        leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(params["base"])
+        leaves = []
+        for kp, _ in leaves_paths:
+            path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+            leaves.append(flat[path])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- model adapter contract ---------------------------------------------
+    def loss_fn(self, params, batch, rng=None):
+        # merge() stop-gradients the base: adapters alone carry the gradient
+        return self.inner.loss_fn(self.merge(params), batch, rng)
+
+    def trainable_mask(self, params) -> Any:
+        """True = trainable (lora), False = frozen (base)."""
+        return {
+            "base": jax.tree_util.tree_map(lambda _: False, params["base"]),
+            "lora": jax.tree_util.tree_map(lambda _: True, params["lora"]),
+        }
+
+    @property
+    def tp_rules(self):
+        rules = getattr(self.inner, "tp_rules", None)
+        if not rules:
+            return None
+        # base keeps the inner model's rules (path prefix 'base/')
+        return [(rf"^base/{p.lstrip('^')}", s) for p, s in rules]
+
+    @property
+    def param_count(self):
+        return getattr(self.inner, "param_count", 0)
+
+    def flops_per_token(self, seq_len: int) -> float:
+        return getattr(self.inner, "flops_per_token", lambda s: 0.0)(seq_len)
+
+    def export_merged(self, params):
+        """Merged full-precision weights (deploy without adapter machinery —
+        the reference's LoRA fuse path, runtime/hybrid_engine.py:132)."""
+        return jax.jit(self.merge)(params)
+
+
+def optimized_linear(x, base_w, lora_a=None, lora_b=None, scale=1.0):
+    """Functional ``OptimizedLinear`` (linear/optimized_linear.py:18): one
+    linear with optional low-rank adapter."""
+    y = x @ base_w
+    if lora_a is not None and lora_b is not None:
+        y = y + (x @ lora_a.astype(x.dtype)) @ lora_b.astype(x.dtype) * scale
+    return y

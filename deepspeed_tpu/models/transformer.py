@@ -37,7 +37,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
-from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, SUB_AXIS
 
 Params = Dict[str, Any]
 
@@ -106,7 +106,7 @@ class TransformerConfig:
 from ..parallel.sharding import set_current_mesh, shard_activation  # noqa: E402
 
 
-ACT_SPEC = P((DATA_AXIS, FSDP_AXIS), SEQ_AXIS, None)  # [batch, seq, hidden]
+ACT_SPEC = P((DATA_AXIS, FSDP_AXIS, SUB_AXIS), SEQ_AXIS, None)  # [batch, seq, hidden]
 
 
 # ---------------------------------------------------------------------------

@@ -22,10 +22,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.sharding import shard_activation
-from ..parallel.topology import DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS
+from ..parallel.topology import DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, MODEL_AXIS, SUB_AXIS
 from .sharded_moe import topk_gating
 
-BATCH = (DATA_AXIS, FSDP_AXIS)
+BATCH = (DATA_AXIS, FSDP_AXIS, SUB_AXIS)
 
 
 def routed_ffn(

@@ -27,15 +27,21 @@ import numpy as np
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+SUB_AXIS = "sub"  # inner factor of fsdp: ZeRO++ hpZ secondary partition /
+# MiCS shard group (reference utils/groups.py:650, runtime/zero/mics.py:64)
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 STAGE_AXIS = "stage"
 
-ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS, STAGE_AXIS)
+ALL_AXES = (
+    DATA_AXIS, FSDP_AXIS, SUB_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS, STAGE_AXIS
+)
 
 # Axes over which gradients are averaged for the dense parameters.
-BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS, SUB_AXIS)
+# The full weight-update-sharding extent (fsdp x its inner sub factor).
+FSDP_AXES = (FSDP_AXIS, SUB_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +52,7 @@ class MeshSpec:
 
     data: int = 1
     fsdp: int = 1
+    sub: int = 1  # inner fsdp factor (hpZ secondary partition / MiCS group)
     model: int = 1
     seq: int = 1
     expert: int = 1
@@ -58,6 +65,7 @@ class MeshSpec:
         return {
             DATA_AXIS: self.data,
             FSDP_AXIS: self.fsdp,
+            SUB_AXIS: self.sub,
             MODEL_AXIS: self.model,
             SEQ_AXIS: self.seq,
             EXPERT_AXIS: self.expert,
@@ -71,7 +79,7 @@ class MeshSpec:
     @property
     def dp_world_size(self) -> int:
         """Number of gradient-averaging replicas (reference: dp_world_size)."""
-        return self.data * self.fsdp
+        return self.data * self.fsdp * self.sub
 
     def replace(self, **kw) -> "MeshSpec":
         return dataclasses.replace(self, **kw)
@@ -123,8 +131,9 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
         raise ValueError(
             f"MeshSpec covers {spec.world_size} devices but {len(devices)} are available"
         )
-    # slowest -> fastest varying
-    order = (STAGE_AXIS, DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+    # slowest -> fastest varying; ``sub`` sits just inside ``fsdp`` so the
+    # hpZ/MiCS secondary gathers ride the tightest ICI neighbourhood
+    order = (STAGE_AXIS, DATA_AXIS, FSDP_AXIS, SUB_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
     shape = tuple(spec.sizes[a] for a in order)
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
@@ -190,7 +199,9 @@ class Grid:
 
         dev = jax.local_devices()[0]
         c = self.coords_of(dev)
-        return c[DATA_AXIS] * self.spec.fsdp + c[FSDP_AXIS]
+        return (
+            c[DATA_AXIS] * self.spec.fsdp + c[FSDP_AXIS]
+        ) * self.spec.sub + c.get(SUB_AXIS, 0)
 
 
 def initialize_mesh(spec: Optional[MeshSpec] = None, devices=None, **axes) -> Grid:

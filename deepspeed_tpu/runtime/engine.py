@@ -94,6 +94,7 @@ class DeepSpeedTpuEngine:
         eval_fn: Optional[Callable] = None,
         seed: Optional[int] = None,
         remat_policy: Optional[str] = None,
+        trainable_mask: Any = None,
     ):
         self.config = config
         self.grid = grid
@@ -123,6 +124,11 @@ class DeepSpeedTpuEngine:
             self.optimizer = build_optimizer(
                 config.optimizer.type, config.optimizer.params, learning_rate=self.lr_schedule_fn
             )
+            if trainable_mask is not None:
+                # frozen leaves (LoRA base weights) carry no optimizer state
+                # and receive no update — reference OptimizedLinear freezes
+                # the base the same way (linear/optimized_linear.py:76)
+                self.optimizer = optax.masked(self.optimizer, trainable_mask)
         self.compute_dtype = precision.compute_dtype(config.precision_dtype)
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
 
@@ -140,6 +146,14 @@ class DeepSpeedTpuEngine:
             and (zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients)
             and grid.spec.fsdp > 1
         ):
+            if grid.spec.sub > 1:
+                from ..config.config import ConfigError
+
+                raise ConfigError(
+                    "zero_quantized_weights/gradients cannot combine with "
+                    "zero_hpz_partition_size/mics_shard_size yet (the int8 "
+                    "collective path shards on the plain fsdp axis)"
+                )
             from . import zeropp
 
             self._zeropp_vag = zeropp.make_micro_value_and_grad(
@@ -182,11 +196,21 @@ class DeepSpeedTpuEngine:
             self.opt_shardings_dev = self.opt_shardings
         else:
             # place masters sharded-at-creation via a device-kind jit (host
-            # out_shardings inside jit are TPU-only), then hop memory kinds
-            place_masters = jax.jit(
-                lambda p: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p),
-                out_shardings=self.master_shardings_dev,
-            )
+            # out_shardings inside jit are TPU-only), then hop memory kinds.
+            # Frozen leaves (LoRA base, trainable_mask=False) keep their
+            # storage dtype: fp32 master precision is only for weights that
+            # actually update (the reference OptimizedLinear's frozen base
+            # likewise never gets an fp32 copy).
+            if trainable_mask is not None:
+                cast = lambda p: jax.tree_util.tree_map(
+                    lambda x, m: x.astype(jnp.float32) if m else x,
+                    p, trainable_mask,
+                )
+            else:
+                cast = lambda p: jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32), p
+                )
+            place_masters = jax.jit(cast, out_shardings=self.master_shardings_dev)
             master_params = place_masters(params)
             opt_shapes = jax.eval_shape(self.optimizer.init, master_params)
             self.opt_shardings_dev = self.plan.opt_state_shardings(self.mesh, opt_shapes)
@@ -782,6 +806,13 @@ class DeepSpeedTpuEngine:
         self._micro_steps += 1
         self._pending = None
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
+
+    def wait_pending_checkpoint(self) -> None:
+        """Block until an async checkpoint save (checkpoint.async_save) has
+        durably committed (reference: NebulaCheckpointEngine commit)."""
+        ce = getattr(self, "_ckpt_engine", None)
+        if ce is not None:
+            ce.wait()
 
     def is_gradient_accumulation_boundary(self) -> bool:
         """reference: engine.py:2166."""

@@ -82,11 +82,11 @@ def pipeline_apply(
     xm = x.reshape((num_micro, mb) + x.shape[1:])
     T = num_micro + num_stages - 1
 
-    from ...parallel.topology import DATA_AXIS, FSDP_AXIS
+    from ...parallel.topology import DATA_AXIS, FSDP_AXIS, SUB_AXIS
     from ...parallel.sharding import filter_spec
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    dp_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if sizes.get(a, 1) > 1)
+    dp_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS, SUB_AXIS) if sizes.get(a, 1) > 1)
 
     def stage_body(local_layers, x_all):
         sid = lax.axis_index(STAGE_AXIS)
@@ -163,7 +163,7 @@ def pipeline_apply(
         return out_buf, aux_total
 
     # microbatch rows shard over the DP axes; everything else replicated
-    batch_entry = filter_spec((mb,), P((DATA_AXIS, FSDP_AXIS)), mesh)[0]
+    batch_entry = filter_spec((mb,), P((DATA_AXIS, FSDP_AXIS, SUB_AXIS)), mesh)[0]
     x_spec = P(*((None, batch_entry) + (None,) * (x.ndim - 1)))
     out_spec = (P(*((None, batch_entry) + (None,) * (x.ndim - 1))), P())
     layer_specs = jax.tree_util.tree_map(

@@ -124,7 +124,7 @@ def make_micro_value_and_grad(
     w = mesh.shape[FSDP_AXIS]
     has_data = mesh.shape.get(DATA_AXIS, 1) > 1
     data_axis = DATA_AXIS if has_data else None
-    dp_axes = (DATA_AXIS, FSDP_AXIS) if has_data else (FSDP_AXIS,)
+    dp_axes = (DATA_AXIS, FSDP_AXIS) if has_data else (FSDP_AXIS,)  # sub>1 + ZeRO++ unsupported
 
     specs_flat = master_specs
 

@@ -23,9 +23,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.sharding import shard_activation
-from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, SUB_AXIS
 
-BATCH = (DATA_AXIS, FSDP_AXIS)
+BATCH = (DATA_AXIS, FSDP_AXIS, SUB_AXIS)
 
 
 def ulysses_spec(phase: str) -> P:

@@ -24,7 +24,7 @@ def test_infer_spec_not_divisible():
 
 def test_mesh_has_all_axes():
     grid = initialize_mesh(fsdp=4, model=2)
-    assert set(grid.mesh.axis_names) == {"data", "fsdp", "model", "seq", "expert", "stage"}
+    assert set(grid.mesh.axis_names) == {"data", "fsdp", "sub", "model", "seq", "expert", "stage"}
     assert grid.mesh.shape["fsdp"] == 4
     assert grid.mesh.shape["model"] == 2
     assert grid.dp_world_size == 4
@@ -41,3 +41,51 @@ def test_grid_sizes():
 def test_mesh_wrong_world_size():
     with pytest.raises(ValueError):
         build_mesh(MeshSpec(data=16))
+
+
+# ---------------------------------------------------------------------------
+# multinode runner command synthesis (reference: tests/unit/launcher — pure
+# unit, no processes)
+# ---------------------------------------------------------------------------
+def test_multinode_runner_commands():
+    from deepspeed_tpu.launcher.multinode_runner import get_runner, RUNNERS
+
+    hosts = {"worker-0": 1, "worker-1": 1, "worker-2": 1}
+    cmd = ["python", "train.py", "--flag"]
+
+    slurm = get_runner("slurm", hosts).get_cmd(cmd)
+    assert slurm[:1] == ["srun"] and "--ntasks" in slurm and "3" in slurm
+    assert "--nodelist" in slurm and slurm[-3:] == cmd
+    export = slurm[slurm.index("--export") + 1]
+    assert "DSTPU_COORDINATOR=worker-0:" in export
+
+    ompi = get_runner("openmpi", hosts, coordinator="worker-1").get_cmd(cmd)
+    assert ompi[0] == "mpirun" and "-x" in ompi
+    assert any("DSTPU_COORDINATOR=worker-1:" in a for a in ompi)
+    assert ompi[-3:] == cmd
+
+    mpich = get_runner("mpich", hosts).get_cmd(cmd)
+    assert mpich[0] == "mpiexec" and "-genv" in mpich
+
+    pdsh = get_runner("pdsh", hosts).get_cmd(cmd)
+    assert pdsh[0] == "pdsh" and "worker-0,worker-1,worker-2" in pdsh
+    assert "DSTPU_PROCESS_ID=$i" in pdsh[-1]
+
+    assert set(RUNNERS) == {"pdsh", "openmpi", "mpich", "slurm", "mvapich"}
+
+
+def test_scheduler_rank_env_discovery(monkeypatch):
+    from deepspeed_tpu.launcher.multinode_runner import scheduler_rank_env
+
+    monkeypatch.delenv("OMPI_COMM_WORLD_RANK", raising=False)
+    monkeypatch.delenv("PMI_RANK", raising=False)
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    assert scheduler_rank_env() is None
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    env = scheduler_rank_env()
+    assert env == {"DSTPU_PROCESS_ID": "3", "DSTPU_NUM_PROCESSES": "8"}
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    env = scheduler_rank_env()
+    assert env["DSTPU_PROCESS_ID"] == "1" and env["DSTPU_NUM_PROCESSES"] == "4"

@@ -1,0 +1,111 @@
+"""ZeRO++ hpZ secondary partition + MiCS shard groups (r2 missing #9).
+
+Reference: utils/groups.py:650 _create_zero_param_parallel_group (hpZ),
+runtime/zero/mics.py:64 MiCS_Init.  Both were accepted-and-ignored config
+knobs in r2; now they factor the fsdp extent into (fsdp, sub) and the plan
+places compute/master shards accordingly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, get_preset
+from deepspeed_tpu.parallel.topology import FSDP_AXIS, SUB_AXIS
+
+
+def _axes_in(spec):
+    out = set()
+    for e in tuple(spec):
+        if e is None:
+            continue
+        for a in (e if isinstance(e, tuple) else (e,)):
+            out.add(a)
+    return out
+
+
+def _mk_engine(zero_cfg, mesh=None):
+    cfg = get_preset("tiny", max_seq_len=32).replace(
+        hidden_size=128, intermediate_size=256
+    )
+    return deepspeed_tpu.initialize(
+        model=CausalLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": zero_cfg,
+        },
+        mesh=mesh,
+    )[0], cfg
+
+
+def test_hpz_secondary_partition_specs():
+    """hpZ: compute params shard over the sub group only; masters over the
+    full (fsdp, sub) extent."""
+    engine, _ = _mk_engine(
+        {"stage": 3, "param_persistence_threshold": 0, "zero_hpz_partition_size": 2}
+    )
+    assert engine.grid.spec.sub == 2
+    assert engine.grid.spec.fsdp == 4  # 8 devices auto-factored
+    wq_param = engine.plan.param_specs["layers"]["attn"]["wq"]
+    wq_master = engine.plan.master_specs["layers"]["attn"]["wq"]
+    # TP axes (size-1 'model') may also appear in the base spec — only
+    # the fsdp-extent placement matters here
+    assert SUB_AXIS in _axes_in(wq_param) and FSDP_AXIS not in _axes_in(wq_param)
+    assert {FSDP_AXIS, SUB_AXIS} <= _axes_in(wq_master)
+
+
+def test_mics_group_sharding_specs():
+    """MiCS: masters AND compute params shard within the group, replicate
+    across groups."""
+    engine, _ = _mk_engine(
+        {"stage": 3, "param_persistence_threshold": 0, "mics_shard_size": 2}
+    )
+    assert engine.grid.spec.sub == 2
+    wq_param = engine.plan.param_specs["layers"]["attn"]["wq"]
+    wq_master = engine.plan.master_specs["layers"]["attn"]["wq"]
+    assert SUB_AXIS in _axes_in(wq_param) and FSDP_AXIS not in _axes_in(wq_param)
+    assert SUB_AXIS in _axes_in(wq_master) and FSDP_AXIS not in _axes_in(wq_master)
+
+
+@pytest.mark.parametrize("knob", [
+    {"zero_hpz_partition_size": 2},
+    {"mics_shard_size": 2},
+])
+def test_hpz_mics_training_parity(knob):
+    """hpZ/MiCS change layouts, not math: loss trajectories match plain
+    ZeRO-3 on the same seeds."""
+    rng = np.random.default_rng(0)
+    base_engine, cfg = _mk_engine({"stage": 3, "param_persistence_threshold": 0})
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 33)).astype(np.int32)}
+    base = [float(base_engine.train_batch(batch)) for _ in range(3)]
+
+    eng, _ = _mk_engine({"stage": 3, "param_persistence_threshold": 0, **knob})
+    got = [float(eng.train_batch(batch)) for _ in range(3)]
+    # layouts change reduction orders: bf16-level drift only
+    np.testing.assert_allclose(got, base, rtol=5e-3, atol=5e-3)
+
+
+def test_hpz_mics_exclusive():
+    with pytest.raises(Exception):
+        _mk_engine({
+            "stage": 3, "zero_hpz_partition_size": 2, "mics_shard_size": 2,
+        })
+
+
+def test_mics_checkpoint_roundtrip(tmp_path):
+    """MiCS-sharded state saves topology-free and restores on a plain mesh."""
+    rng = np.random.default_rng(1)
+    eng, cfg = _mk_engine({"stage": 3, "param_persistence_threshold": 0,
+                           "mics_shard_size": 2})
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (8, 33)).astype(np.int32)}
+    eng.train_batch(batch)
+    eng.save_checkpoint(str(tmp_path))
+    after = float(eng.train_batch(batch))
+
+    plain, _ = _mk_engine({"stage": 3, "param_persistence_threshold": 0})
+    plain.load_checkpoint(str(tmp_path))
+    got = float(plain.train_batch(batch))
+    assert abs(got - after) < 2e-3, (got, after)
