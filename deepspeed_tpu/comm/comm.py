@@ -236,22 +236,24 @@ def init_distributed(
         return
     import os
 
+    # fill EVERY missing piece independently from the launcher env
+    # (launcher/runner.py) or the scheduler env (the reference's
+    # mpi_discovery, comm/comm.py:694) — an explicit coordinator must not
+    # disable rank discovery
     if coordinator_address is None:
-        # launcher env (launcher/runner.py) or scheduler env (the
-        # reference's mpi_discovery, comm/comm.py:694)
         coordinator_address = os.environ.get("DSTPU_COORDINATOR")
-        if num_processes is None and "DSTPU_NUM_PROCESSES" in os.environ:
-            num_processes = int(os.environ["DSTPU_NUM_PROCESSES"])
-        if process_id is None and "DSTPU_PROCESS_ID" in os.environ:
-            process_id = int(os.environ["DSTPU_PROCESS_ID"])
-        if process_id is None:
-            from ..launcher.multinode_runner import scheduler_rank_env
+    if num_processes is None and "DSTPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DSTPU_NUM_PROCESSES"])
+    if process_id is None and "DSTPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DSTPU_PROCESS_ID"])
+    if process_id is None:
+        from ..launcher.multinode_runner import scheduler_rank_env
 
-            sched = scheduler_rank_env()
-            if sched is not None:
-                process_id = int(sched["DSTPU_PROCESS_ID"])
-                if num_processes is None:
-                    num_processes = int(sched["DSTPU_NUM_PROCESSES"])
+        sched = scheduler_rank_env()
+        if sched is not None:
+            process_id = int(sched["DSTPU_PROCESS_ID"])
+            if num_processes is None:
+                num_processes = int(sched["DSTPU_NUM_PROCESSES"])
     if coordinator_address is not None or num_processes not in (None, 1):
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

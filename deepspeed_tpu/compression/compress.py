@@ -243,21 +243,19 @@ class CompressionManager:
 
 
 def _flatten_with_paths(tree) -> Dict[str, Any]:
-    flat = {}
-    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        path = "/".join(
-            str(getattr(k, "key", getattr(k, "idx", k))) for k in kp
-        )
-        flat[path] = leaf
-    return flat
+    from ..runtime.zero import path_str
+
+    return {
+        path_str(kp): leaf
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
 
 
 def _unflatten_with_paths(ref_tree, flat: Dict[str, Any]):
+    from ..runtime.zero import path_str
+
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(ref_tree)
-    leaves = []
-    for kp, _ in paths_leaves:
-        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
-        leaves.append(flat[path])
+    leaves = [flat[path_str(kp)] for kp, _ in paths_leaves]
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

@@ -69,11 +69,16 @@ class PDSHRunner(MultiNodeRunner):
     def get_cmd(self, user_cmd: List[str]) -> List[str]:
         env = self._rendezvous_env()
         hostlist = ",".join(self.hosts)
-        # rank = index of $(hostname) in the host list, resolved remotely
+        # rank = index of this node in the host list, matched against both
+        # the short and the fully-qualified hostname (hostfiles may carry
+        # FQDNs/IPs); a miss is a loud error, not an out-of-range rank
         hosts_spaced = " ".join(self.hosts)
+        n = self.num_hosts
         bootstrap = (
             f"i=0; for h in {hosts_spaced}; do "
-            "[ \"$h\" = \"$(hostname)\" ] && break; i=$((i+1)); done; "
+            "{ [ \"$h\" = \"$(hostname)\" ] || [ \"$h\" = \"$(hostname -f)\" ]; } "
+            "&& break; i=$((i+1)); done; "
+            f"[ $i -lt {n} ] || {{ echo \"dstpu: $(hostname) not in host list\" >&2; exit 1; }}; "
             + " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
             + " DSTPU_PROCESS_ID=$i "
             + " ".join(shlex.quote(c) for c in user_cmd)
@@ -145,6 +150,7 @@ class MVAPICHRunner(MultiNodeRunner):
         return shutil.which("mpirun_rsh") is not None
 
     def get_cmd(self, user_cmd: List[str]) -> List[str]:
+        import atexit
         import tempfile
 
         fh = tempfile.NamedTemporaryFile(
@@ -153,6 +159,7 @@ class MVAPICHRunner(MultiNodeRunner):
         for h in self.hosts:
             fh.write(f"{h}\n")
         fh.close()
+        atexit.register(lambda p=fh.name: os.path.exists(p) and os.unlink(p))
         cmd = ["mpirun_rsh", "-np", str(self.num_hosts), "-hostfile", fh.name]
         for k, v in self._rendezvous_env().items():
             cmd.append(f"{k}={v}")

@@ -49,9 +49,10 @@ def _match(path: str, patterns: Sequence[str]) -> bool:
 
 
 def _paths_and_leaves(tree):
+    from ..runtime.zero import path_str
+
     for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
-        yield path, leaf
+        yield path_str(kp), leaf
 
 
 class LoRACausalLM:
@@ -119,11 +120,10 @@ class LoRACausalLM:
             return flat
 
         flat = merged()
+        from ..runtime.zero import path_str
+
         leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(params["base"])
-        leaves = []
-        for kp, _ in leaves_paths:
-            path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
-            leaves.append(flat[path])
+        leaves = [flat[path_str(kp)] for kp, _ in leaves_paths]
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # -- model adapter contract ---------------------------------------------

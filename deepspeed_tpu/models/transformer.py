@@ -405,6 +405,22 @@ def forward(
                 ),
                 prevent_cse=False,
             )
+        elif cfg.remat == "offload":
+            # FPDT-style host offload (reference sequence/fpdt_layer.py:510
+            # _FPDTGPUOffloadingAttentionImpl_ / SequenceChunk:462): the
+            # per-layer save points move to pinned host memory, bounding
+            # device activation memory for multi-million-token sequences;
+            # XLA streams them back during backward
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_and_offload_only_these_names(
+                    names_which_can_be_saved=[],
+                    names_which_can_be_offloaded=list(_SELECTIVE_SAVE_NAMES),
+                    offload_src="device",
+                    offload_dst="pinned_host",
+                ),
+                prevent_cse=False,
+            )
 
         layer_params = params["layers"]
         x, (new_caches, aux_losses) = jax.lax.scan(body, x, (layer_params, cache))

@@ -1,0 +1,113 @@
+"""AutoTP: infer tensor-parallel sharding rules from an arbitrary param tree.
+
+Reference: ``module_inject/auto_tp.py:193 AutoTP`` walks the module graph,
+classifies each ``nn.Linear`` as column-parallel (``LinearLayer``) or
+row-parallel (``LinearAllreduce``) from its position/name, and swaps
+modules.  Here the same classification runs over parameter *paths and
+shapes* and emits regex->PartitionSpec rules for the ZeRO planner
+(``runtime/zero.py match_rules``) — no surgery, and it works for any
+user-provided pytree, not just our model family.
+
+Heuristics (mirroring the reference's policy tables):
+- names matching the ROW patterns (out/down/o_proj/fc2/dense_4h_to_h/wo...)
+  shard the INPUT dim on ``model`` (their outputs need the allreduce the
+  reference's LinearAllreduce performs — GSPMD inserts it from the layout);
+- other 2D+ weights shard the OUTPUT dim (column-parallel);
+- embedding-like leaves (vocab-sized dim) shard the vocab dim;
+- 1D leaves (biases/norms) follow their producer: a bias whose size matches
+  a column-parallel output shards the same way; norms replicate;
+- dims must divide the ``model`` axis size or the leaf replicates.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .topology import MODEL_AXIS
+
+# reference auto_tp policy vocabulary (module_inject/auto_tp.py:270-330
+# class-specific allreduce linears) + our naming
+ROW_PATTERNS = (
+    r"o_proj", r"down_proj", r"out_proj", r"dense_4h_to_h", r"fc2", r"wo\b",
+    r"w_down", r"w2\b", r"attention\.dense", r"self_attention\.dense",
+    r"mlp\.dense_4h_to_h", r"proj_out",
+)
+EMBED_PATTERNS = (r"embed", r"wte", r"word_embeddings", r"lm_head", r"tok_embeddings")
+
+
+def _path_of(kp) -> str:
+    from ..runtime.zero import path_str
+
+    return path_str(kp)
+
+
+def infer_tp_rules(
+    params_or_shapes: Any,
+    model_axis_size: int,
+    vocab_size: Optional[int] = None,
+) -> List[Tuple[str, P]]:
+    """Emit (regex, PartitionSpec) rules for every shardable leaf.
+
+    ``params_or_shapes``: a pytree of arrays or ShapeDtypeStructs.
+    Returns exact-path rules (regex-escaped), consumable by
+    ``zero.plan_sharding(tp_rules=...)``.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params_or_shapes)[0]
+    rules: List[Tuple[str, P]] = []
+    col_out_sizes: Dict[int, bool] = {}
+
+    def divides(dim: int) -> bool:
+        return model_axis_size > 0 and dim % model_axis_size == 0
+
+    # pass 1: 2D+ weights
+    for kp, leaf in flat:
+        path = _path_of(kp)
+        shape = tuple(leaf.shape)
+        if len(shape) < 2:
+            continue
+        lead = len(shape) - 2  # stacked layer/expert dims stay unsharded
+        fan_in, fan_out = shape[-2], shape[-1]
+        entry: List[Any] = [None] * len(shape)
+        lower = path.lower()
+        if any(re.search(p, lower) for p in EMBED_PATTERNS):
+            # vocab-dim sharding (reference VocabParallelEmbedding analogue)
+            v_dims = [i for i, d in enumerate(shape)
+                      if vocab_size and d == vocab_size and divides(d)]
+            if v_dims:
+                entry[v_dims[0]] = MODEL_AXIS
+                rules.append((f"^{re.escape(path)}$", P(*entry)))
+            continue
+        if any(re.search(p, lower) for p in ROW_PATTERNS):
+            if divides(fan_in):
+                entry[lead] = MODEL_AXIS  # row-parallel: input dim
+                rules.append((f"^{re.escape(path)}$", P(*entry)))
+            continue
+        if divides(fan_out):
+            entry[lead + 1] = MODEL_AXIS  # column-parallel: output dim
+            col_out_sizes[fan_out] = True
+            rules.append((f"^{re.escape(path)}$", P(*entry)))
+
+    # pass 2: biases follow column-parallel outputs; everything else
+    # (norms, scalars) replicates by omission
+    for kp, leaf in flat:
+        path = _path_of(kp)
+        shape = tuple(leaf.shape)
+        if len(shape) < 1 or len(shape) >= 2:
+            continue
+        lower = path.lower()
+        if "bias" in lower or re.search(r"/b[qkv]$", path):
+            if col_out_sizes.get(shape[-1]) and divides(shape[-1]):
+                rules.append((f"^{re.escape(path)}$", P(MODEL_AXIS)))
+    return rules
+
+
+def infer_tp_rules_stacked(
+    params_or_shapes: Any, model_axis_size: int, vocab_size: Optional[int] = None
+) -> List[Tuple[str, P]]:
+    """Variant for stacked-layer trees ([L, in, out] leaves) — identical
+    classification; the leading dims are already skipped by infer_tp_rules."""
+    return infer_tp_rules(params_or_shapes, model_axis_size, vocab_size)

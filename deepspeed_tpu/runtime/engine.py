@@ -144,7 +144,7 @@ class DeepSpeedTpuEngine:
         if (
             zcfg.stage >= 3
             and (zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients)
-            and grid.spec.fsdp > 1
+            and grid.spec.fsdp * grid.spec.sub > 1
         ):
             if grid.spec.sub > 1:
                 from ..config.config import ConfigError

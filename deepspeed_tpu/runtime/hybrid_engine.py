@@ -1,0 +1,144 @@
+"""Hybrid engine: RLHF train <-> generate on one set of weights.
+
+Reference: ``runtime/hybrid_engine.py:30 DeepSpeedHybridEngine`` — trains
+under ZeRO-3 while flipping the same parameters into inference containers
+for fast generation (``_zero3_forward:362``), fusing/unfusing LoRA around
+generate (``:132-146``).
+
+TPU formulation: no container surgery or param flipping — the serving
+engine's jits take parameters as explicit arguments, so ``generate`` simply
+hands the *live training params* (cast to the compute dtype, LoRA merged if
+present) to a persistent ``InferenceEngineV2``.  Zero weight copies are
+kept: the cast is one fused jit whose output is consumed by the generate
+dispatches and freed after.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.engine_v2 import InferenceEngineV2
+from ..inference.sampling import SamplingParams
+from ..utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine:
+    """Wrap a training engine with a generate() path over the live weights.
+
+    ``engine`` — a DeepSpeedTpuEngine built from a ``models.CausalLM`` (or
+    ``LoRACausalLM``) via ``initialize``.  All training methods delegate;
+    ``generate`` runs continuous-batched inference against the current step's
+    parameters.
+    """
+
+    def __init__(
+        self,
+        engine,
+        max_seqs: int = 8,
+        num_blocks: int = 256,
+        block_size: int = 32,
+        max_seq_len: Optional[int] = None,
+        **inference_kw,
+    ):
+        model = getattr(engine, "model", None)
+        if model is None or not hasattr(model, "cfg"):
+            raise ValueError(
+                "DeepSpeedHybridEngine needs an engine built from a model "
+                "adapter (deepspeed_tpu.models.CausalLM / LoRACausalLM)"
+            )
+        self.engine = engine
+        self.model = model
+        self._lora = hasattr(model, "merge")  # LoRACausalLM contract
+        cfg = model.cfg
+        self._infer_cfg = cfg.replace(act_quant_bits=None)
+        self._inference = InferenceEngineV2(
+            params=self._serving_params(),
+            cfg=self._infer_cfg,
+            max_seqs=max_seqs,
+            num_blocks=num_blocks,
+            block_size=block_size,
+            max_seq_len=max_seq_len,
+            **inference_kw,
+        )
+        self._params_step = int(engine.global_steps)
+        log_dist(
+            "hybrid engine ready: train (ZeRO) + generate (paged serving) on "
+            "shared weights"
+        )
+
+    # -- weight bridge -------------------------------------------------------
+    def _serving_params(self):
+        """Live training params -> compute-dtype serving tree (LoRA merged —
+        the reference's fuse_lora before generate)."""
+        params = self.engine.state.params
+        dtype = self._infer_cfg.dtype
+
+        def cast_tree(p):
+            merged = self.model.merge(p) if self._lora else p
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                merged,
+            )
+
+        if not hasattr(self, "_cast_jit"):
+            self._cast_jit = jax.jit(cast_tree)
+        return self._cast_jit(params)
+
+    def refresh(self) -> None:
+        """Push the current training weights into the serving engine (called
+        automatically when the step count moved since the last generate)."""
+        self._inference.params = self._serving_params()
+        self._params_step = int(self.engine.global_steps)
+
+    # -- generate ------------------------------------------------------------
+    def generate(
+        self,
+        prompt_tokens: Sequence[int],
+        sampling: SamplingParams = SamplingParams(),
+    ) -> List[int]:
+        if int(self.engine.global_steps) != self._params_step:
+            self.refresh()
+        return self._inference.generate(prompt_tokens, sampling)
+
+    def generate_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        sampling: SamplingParams = SamplingParams(),
+    ) -> List[List[int]]:
+        """Batched RLHF rollout: packed prefill + shared decode ticks."""
+        if int(self.engine.global_steps) != self._params_step:
+            self.refresh()
+        inf = self._inference
+        base = max(inf.mgr.seqs, default=0) + 1  # never collide with live uids
+        uids = list(range(base, base + len(prompts)))
+        first = inf.put(uids, prompts, sampling)
+        lens = {u: len(p) for u, p in zip(uids, prompts)}
+        while True:
+            for u in uids:
+                seq = inf.mgr.seqs[u]
+                if seq.cur_len - lens[u] >= sampling.max_new_tokens:
+                    # finished rollouts must stop consuming decode work and
+                    # KV pages (step() skips done sequences)
+                    seq.done = True
+            if all(inf.mgr.seqs[u].done for u in uids):
+                break
+            if not inf.step(sampling):
+                break
+        results = []
+        for u in uids:
+            toks = inf.mgr.seqs[u].tokens[lens[u]:]
+            if sampling.stop_token is not None and toks and toks[-1] == sampling.stop_token:
+                toks = toks[:-1]
+            results.append(toks[: sampling.max_new_tokens])
+        inf.flush(uids)
+        return results
+
+    # -- training delegation -------------------------------------------------
+    def train_batch(self, batch):
+        return self.engine.train_batch(batch)
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
