@@ -48,15 +48,15 @@ def main():
         # intermediates), chunked vocab CE, micro=8 — measured 0.52 MFU on
         # v5e vs 0.32 for r2's remat=full micro=4 stage-1 config
         cfg = get_preset("llama3_proxy_410m", remat="selective", loss_chunk_size=2048)
-        micro, seq, steps = 8, 4096, 10
+        micro, seq, steps, gas = 8, 4096, 6, 2
     else:  # smoke-test mode off-TPU so the script always completes
         cfg = get_preset("tiny", max_seq_len=256)
-        micro, seq, steps = 2, 256, 3
+        micro, seq, steps, gas = 2, 256, 3, 1
 
     model = CausalLM(cfg)
     config = {
         "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.1}},
         # north-star path: ZeRO-3 (BASELINE.json); persistence threshold 0
         # forces the full cast/gather machinery through the compiler even on
@@ -67,7 +67,7 @@ def main():
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
     rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (1, micro, seq + 1), dtype=np.int64)}
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (gas, micro, seq + 1), dtype=np.int64)}
 
     loss = engine.train_batch(batch)  # compile + warmup
     float(loss)  # full host sync (block_until_ready is unreliable on axon)
@@ -79,7 +79,7 @@ def main():
         float(loss)
         dt = min(dt, (time.perf_counter() - t0) / steps)
 
-    tokens_per_step = micro * seq
+    tokens_per_step = gas * micro * seq
     tok_s = tokens_per_step / dt
     flops_per_token = model.flops_per_token(seq)
     mfu = tok_s * flops_per_token / device_peak_flops()

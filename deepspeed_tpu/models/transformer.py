@@ -353,6 +353,7 @@ def forward(
     cache_index: Optional[jnp.ndarray] = None,
     return_hidden: bool = False,
     stack_apply: Optional[Callable] = None,
+    layer_keep: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[Params], jnp.ndarray]:
     """tokens [b, s] -> (logits [b, s, v] | hidden, new_cache, moe_aux_loss).
 
@@ -374,6 +375,11 @@ def forward(
     x = shard_activation(x, ACT_SPEC)
 
     if stack_apply is not None:
+        if layer_keep is not None:
+            raise NotImplementedError(
+                "layer_keep (progressive layer drop) is not supported on the "
+                "stack_apply/pipelined path"
+            )
         out = stack_apply(params["layers"], x, positions)
         # pipelined stacks return (x, moe_aux_loss); plain ones just x
         x, aux_loss = out if isinstance(out, tuple) else (
@@ -383,11 +389,29 @@ def forward(
     else:
         def body(carry, scanned):
             h = carry
-            lw, layer_cache = scanned
-            h, new_cache, aux = decoder_layer(
-                lw, h, cfg, positions, attn_fn, segment_ids, layer_cache, cache_index
-            )
-            return h, (new_cache, aux)
+            lw, layer_cache, keep = scanned
+
+            def run_layer(h):
+                return decoder_layer(
+                    lw, h, cfg, positions, attn_fn, segment_ids, layer_cache,
+                    cache_index,
+                )
+
+            if keep is None:
+                h_new, new_cache, aux = run_layer(h)
+            else:
+                # progressive layer drop (runtime/progressive_layer_drop.py):
+                # a dropped layer is the identity.  lax.cond executes ONE
+                # branch at runtime, so dropped layers skip their compute —
+                # the training-speed tradeoff PLD exists for ('the lower the
+                # theta, the faster the training', reference PLD post)
+                def skipped(h):
+                    return h, layer_cache, jnp.asarray(0.0, jnp.float32)
+
+                h_new, new_cache, aux = jax.lax.cond(
+                    keep > 0, run_layer, skipped, h
+                )
+            return h_new, (new_cache, aux)
 
         if cfg.remat == "full":
             body = jax.checkpoint(body, prevent_cse=False)
@@ -423,7 +447,9 @@ def forward(
             )
 
         layer_params = params["layers"]
-        x, (new_caches, aux_losses) = jax.lax.scan(body, x, (layer_params, cache))
+        x, (new_caches, aux_losses) = jax.lax.scan(
+            body, x, (layer_params, cache, layer_keep)
+        )
         aux_loss = jnp.sum(aux_losses)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)

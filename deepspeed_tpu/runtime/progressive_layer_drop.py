@@ -1,0 +1,61 @@
+"""Progressive layer drop (PLD).
+
+Reference: ``runtime/progressive_layer_drop.py:10 ProgressiveLayerDrop`` —
+the keep-probability schedule theta(t) = (1 - theta) * gamma-decay + theta,
+consumed by PLD-aware transformer blocks; engine hook at engine.py:1959.
+
+TPU integration: ``layer_keep_mask`` draws one Bernoulli per layer from the
+schedule's theta; ``models.transformer.forward`` consumes it inside the
+scanned stack — a dropped layer's block becomes the identity (its compute
+still runs in the traced program; the gradient contribution is zeroed by
+the mask, matching stochastic-depth semantics with static shapes).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """theta(t) schedule (reference :10): keep probability anneals from 1
+    toward ``theta`` with rate ``gamma``."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        def _prob(x, gamma, p):
+            return (1.0 - p) * math.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta
+
+    def theta_at(self, global_step) -> jnp.ndarray:
+        """Traced variant for in-graph schedules."""
+        x = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * x) + self.theta
+
+
+def layer_keep_mask(
+    rng: jax.Array, num_layers: int, theta, always_keep_first: bool = True
+) -> jnp.ndarray:
+    """[L] float mask: 1 = run the layer, 0 = identity skip.  The first
+    layer is conventionally always kept (the reference's PLD keeps the
+    embedding-adjacent block)."""
+    keep = jax.random.bernoulli(
+        rng, jnp.asarray(theta, jnp.float32), (num_layers,)
+    ).astype(jnp.float32)
+    if always_keep_first:
+        keep = keep.at[0].set(1.0)
+    return keep
