@@ -48,7 +48,10 @@ def flash_attention(
     from .flash_kernel import pallas_flash_attention, supports
 
     if supports(q, k, v, causal, q_offset, segment_ids, logits_soft_cap):
-        return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+        return pallas_flash_attention(
+            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
+            kv_segment_ids=kv_segment_ids, logits_soft_cap=logits_soft_cap,
+        )
     return dot_product_attention(
         q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,
         kv_segment_ids=kv_segment_ids, scale=scale, logits_soft_cap=logits_soft_cap,

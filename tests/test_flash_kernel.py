@@ -56,3 +56,80 @@ def test_supports_gating():
     assert not flash_kernel.supports(q[:, :100], k[:, :100], k[:, :100], True, 0, None, None)
     q2 = jnp.zeros((1, 128, 4, 80))
     assert not flash_kernel.supports(q2, q2, q2, True, 0, None, None)  # head dim
+
+
+def test_flash_segment_ids_parity():
+    """Packed-sequence masking: kernel matches the dense body fwd + grads."""
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+    from deepspeed_tpu.ops.pallas.flash_kernel import pallas_flash_attention
+    from deepspeed_tpu.ops.attention import dot_product_attention
+
+    fk.set_interpret(True)
+    fk.set_block_sizes(64, 64)
+    try:
+        rng = np.random.default_rng(0)
+        b, s, hq, hkv, d = 2, 128, 4, 2, 64
+        q = jnp.asarray(rng.normal(size=(b, s, hq, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32)
+        # three packed documents per row
+        seg = np.zeros((b, s), np.int32)
+        seg[:, 40:90] = 1
+        seg[:, 90:] = 2
+        seg = jnp.asarray(seg)
+
+        out_k = pallas_flash_attention(q, k, v, causal=True, segment_ids=seg)
+        out_d = dot_product_attention(q, k, v, causal=True, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d), atol=2e-5)
+
+        gk = jax.grad(lambda q, k, v: pallas_flash_attention(
+            q, k, v, causal=True, segment_ids=seg).sum(), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, segment_ids=seg).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, c in zip(gk, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=5e-4)
+    finally:
+        fk.set_block_sizes(None, None)
+        fk.set_interpret(False)
+
+
+def test_flash_soft_cap_parity():
+    """gemma-2 tanh cap: kernel matches the dense body fwd + grads."""
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+    from deepspeed_tpu.ops.pallas.flash_kernel import pallas_flash_attention
+    from deepspeed_tpu.ops.attention import dot_product_attention
+
+    fk.set_interpret(True)
+    fk.set_block_sizes(64, 64)
+    try:
+        rng = np.random.default_rng(1)
+        b, s, h, d = 2, 128, 4, 64
+        q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        cap = 30.0
+        out_k = pallas_flash_attention(q, k, v, causal=True, logits_soft_cap=cap)
+        out_d = dot_product_attention(q, k, v, causal=True, logits_soft_cap=cap)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d), atol=2e-5)
+
+        gk = jax.grad(lambda q, k, v: pallas_flash_attention(
+            q, k, v, causal=True, logits_soft_cap=cap).sum(), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, logits_soft_cap=cap).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, c in zip(gk, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=5e-4)
+    finally:
+        fk.set_block_sizes(None, None)
+        fk.set_interpret(False)
+
+
+def test_flash_dispatcher_uses_kernel_for_segments_and_cap():
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+
+    q = jnp.zeros((1, 256, 4, 64), jnp.float32)
+    k = jnp.zeros((1, 256, 2, 64), jnp.float32)
+    seg = jnp.zeros((1, 256), jnp.int32)
+    assert fk.supports(q, k, k, True, 0, seg, None)
+    assert fk.supports(q, k, k, True, 0, None, 30.0)
+    assert fk.supports(q, k, k, True, 0, seg, 30.0)
+    assert not fk.supports(q, k, k, False, 0, None, None)  # non-causal
