@@ -8,4 +8,11 @@ from .indexed_dataset import (  # noqa: F401
     MMapIndexedDataset,
     MMapIndexedDatasetBuilder,
 )
+from .random_ltd import (  # noqa: F401
+    RandomLTDScheduler,
+    gather_tokens,
+    random_ltd_layer,
+    sample_kept_indices,
+    scatter_tokens,
+)
 from .sampler import DeepSpeedDataSampler, find_fit_int_dtype  # noqa: F401

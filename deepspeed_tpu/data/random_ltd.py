@@ -1,0 +1,96 @@
+"""Random layer token dropping (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` + ``scheduler.py RandomLTDScheduler`` (+ CUDA
+token_sort kernels in csrc/random_ltd): middle layers process a random
+subset of tokens; the kept count ramps from ``random_ltd_layer_num`` config
+to the full sequence over the schedule.
+
+TPU formulation: static shapes — the scheduler's kept-token count picks a
+BUCKET (multiple of ``granularity``), tokens are gathered to [b, kept, d]
+for the sandwiched layers and scattered back (the reference's
+gather/scatter kernels are one jnp take/scatter here).  Each distinct
+bucket is one cached XLA compilation, the same cost model as seqlen
+curriculum (data/curriculum_scheduler.py).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py):
+    linear ramp from ``start_tokens`` to ``seq_len`` over
+    ``total_steps``, quantized to ``granularity``."""
+
+    def __init__(
+        self,
+        start_tokens: int,
+        seq_len: int,
+        total_steps: int,
+        granularity: int = 16,
+    ):
+        if start_tokens > seq_len:
+            raise ValueError("start_tokens must be <= seq_len")
+        self.start_tokens = start_tokens
+        self.seq_len = seq_len
+        self.total_steps = total_steps
+        self.granularity = granularity
+        self.current = start_tokens
+
+    def get_current_seq(self) -> int:
+        return self.current
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(max(global_step / max(self.total_steps, 1), 0.0), 1.0)
+        kept = int(self.start_tokens + frac * (self.seq_len - self.start_tokens))
+        kept -= kept % self.granularity
+        if kept + self.granularity > self.seq_len:
+            # endpoint snap: quantizing down must not leave the schedule
+            # permanently short of full sequence length
+            kept = self.seq_len
+        self.current = min(max(kept, self.granularity), self.seq_len)
+        return self.current
+
+    def state_dict(self):
+        return {"current": self.current}
+
+    def load_state_dict(self, state):
+        self.current = int(state["current"])
+
+
+def sample_kept_indices(rng: jax.Array, batch: int, seq_len: int, kept: int) -> jnp.ndarray:
+    """[b, kept] sorted random token indices (the reference's token_sort
+    kernel: random selection, order-preserving)."""
+    noise = jax.random.uniform(rng, (batch, seq_len))
+    idx = jnp.argsort(noise, axis=-1)[:, :kept]
+    return jnp.sort(idx, axis=-1)
+
+
+def gather_tokens(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """[b, s, d] -> [b, kept, d] (reference csrc/random_ltd gather)."""
+    return jnp.take_along_axis(x, idx[:, :, None], axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, sub: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Scatter processed [b, kept, d] back into [b, s, d]; untouched rows
+    keep their previous values (the reference's scatter semantics)."""
+    b = full.shape[0]
+    bi = jnp.arange(b)[:, None]
+    return full.at[bi, idx].set(sub.astype(full.dtype))
+
+
+def random_ltd_layer(
+    x: jnp.ndarray, layer_fn, rng: jax.Array, kept: int
+) -> jnp.ndarray:
+    """Run ``layer_fn`` on a random ``kept``-token subset of ``x`` and
+    scatter results back — the RandomLayerTokenDrop wrapper as a function."""
+    b, s, _ = x.shape
+    if kept >= s:
+        return layer_fn(x)
+    idx = sample_kept_indices(rng, b, s, kept)
+    sub = layer_fn(gather_tokens(x, idx))
+    return scatter_tokens(x, sub, idx)

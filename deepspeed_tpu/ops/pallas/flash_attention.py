@@ -36,10 +36,9 @@ def flash_attention(
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
 ):
-    """[b, s, h, d] flash attention; currently delegates to the fused-by-XLA
-    reference body until the hand-tiled kernel (in progress) lands; the
-    pallas kernel is only selected when it beats XLA's fusion on the bench.
-    """
+    """[b, s, h, d] flash attention: dispatches to the hand-tiled Pallas
+    kernel (flash_kernel.py — causal, GQA, packed segments, soft cap) when
+    ``supports()`` holds, else the fused-by-XLA reference body."""
     if not is_compatible():
         return dot_product_attention(
             q, k, v, causal=causal, q_offset=q_offset, segment_ids=segment_ids,

@@ -429,7 +429,6 @@ def pallas_flash_attention(
     cross-sequence attention for packed batches; ``logits_soft_cap`` is the
     gemma-2 tanh cap."""
     b, s, hq, d = q.shape
-    hkv = k.shape[2]
     scale = float(scale) if scale is not None else float(d) ** -0.5
     cap = float(logits_soft_cap) if logits_soft_cap is not None else None
 
@@ -445,7 +444,6 @@ def pallas_flash_attention(
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         seg = segment_ids.astype(jnp.int32)
         kv_seg = kv_seg.astype(jnp.int32)
-        # expand per-batch segments to head-major rows (int32 [b*h, s])
         # [b, s, 1]: one row per batch, routed to every head by the
         # index map; trailing singleton keeps the block tile-aligned on TPU
         qseg = seg[:, :, None]

@@ -1,0 +1,156 @@
+"""Block-sparse attention: sparsity patterns + masked attention body.
+
+Reference: ``deepspeed/ops/sparse_attention/`` — triton block-sparse matmul/
+softmax kernels driven by ``sparsity_config.py`` pattern classes
+(``FixedSparsityConfig``, ``VariableSparsityConfig``, ``BigBirdSparsityConfig``,
+``BSLongformerSparsityConfig``; selected via runtime/config.py:324-445).
+
+TPU formulation: patterns build a **block-level mask** [n_q_blocks,
+n_k_blocks]; attention applies it as an element mask in the fused XLA body
+(`block_sparse_attention`).  XLA's fusion already avoids materializing the
+masked softmax poorly, and the block mask composes with causal masking; the
+Pallas flash kernel covers the dense-causal hot path, while these patterns
+serve the reference's long-sequence sparse configs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SparsityConfig:
+    """Base (reference sparsity_config.py:12): block size + head behaviour."""
+
+    num_heads: int = 1
+    block: int = 64
+    different_layout_per_head: bool = False  # layouts are per-pattern here
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        """[n_blocks, n_blocks] bool — override per pattern."""
+        raise NotImplementedError
+
+    def _n(self, seq_len: int) -> int:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} % block {self.block} != 0")
+        return seq_len // self.block
+
+
+@dataclass
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks attend (reference DenseSparsityConfig)."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        return np.ones((n, n), bool)
+
+
+@dataclass
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + periodic global blocks (reference
+    FixedSparsityConfig: num_local_blocks, num_global_blocks)."""
+
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        layout = np.zeros((n, n), bool)
+        # local: blocks attend within their num_local_blocks-sized window
+        for i in range(n):
+            w0 = (i // self.num_local_blocks) * self.num_local_blocks
+            layout[i, w0 : w0 + self.num_local_blocks] = True
+        # global: the last num_global_blocks of each window attend/are
+        # attended everywhere (the reference's fixed 'summary' blocks)
+        for w0 in range(0, n, self.num_local_blocks):
+            g0 = min(w0 + self.num_local_blocks, n) - self.num_global_blocks
+            for g in range(max(g0, 0), min(w0 + self.num_local_blocks, n)):
+                layout[:, g] = True
+        return layout
+
+
+@dataclass
+class BigBirdSparsityConfig(SparsityConfig):
+    """random + sliding-window + global blocks (reference
+    BigBirdSparsityConfig: num_random_blocks, num_sliding_window_blocks,
+    num_global_blocks)."""
+
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    num_global_blocks: int = 1
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        layout = np.zeros((n, n), bool)
+        half = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            layout[i, max(0, i - half) : min(n, i + half + 1)] = True
+        g = min(self.num_global_blocks, n)
+        layout[:g, :] = True
+        layout[:, :g] = True
+        rng = np.random.default_rng(self.seed)
+        for i in range(n):
+            for r in rng.choice(n, size=min(self.num_random_blocks, n), replace=False):
+                layout[i, r] = True
+        return layout
+
+
+@dataclass
+class BSLongformerSparsityConfig(SparsityConfig):
+    """sliding window + designated global blocks (reference
+    BSLongformerSparsityConfig)."""
+
+    num_sliding_window_blocks: int = 3
+    global_block_indices: tuple = (0,)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        layout = np.zeros((n, n), bool)
+        half = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            layout[i, max(0, i - half) : min(n, i + half + 1)] = True
+        for g in self.global_block_indices:
+            if g < n:
+                layout[g, :] = True
+                layout[:, g] = True
+        return layout
+
+
+def block_sparse_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    config: SparsityConfig,
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """[b, s, h, d] attention restricted to the config's block layout.
+
+    The block layout expands to an element mask fused into the softmax; with
+    causal=True the effective mask is layout AND causal (the reference's
+    triton kernels compose the same way).
+    """
+    from .attention import make_causal_mask, repeat_kv
+
+    b, s, hq, d = q.shape
+    layout = jnp.asarray(config.make_layout(s))
+    elem = jnp.repeat(jnp.repeat(layout, config.block, 0), config.block, 1)
+    in_dtype = q.dtype
+    hkv = k.shape[2]
+    k = repeat_kv(k, hq // hkv)
+    v = repeat_kv(v, hq // hkv)
+    scale = scale if scale is not None else float(d) ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = elem
+    if causal:
+        mask = jnp.logical_and(mask, make_causal_mask(s, s) >= 0)
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(in_dtype), v)

@@ -133,3 +133,36 @@ def test_flash_dispatcher_uses_kernel_for_segments_and_cap():
     assert fk.supports(q, k, k, True, 0, None, 30.0)
     assert fk.supports(q, k, k, True, 0, seg, 30.0)
     assert not fk.supports(q, k, k, False, 0, None, None)  # non-causal
+
+
+def test_flash_attention_dispatcher_forwards_kwargs(monkeypatch):
+    """End-to-end through flash_attention(): segment_ids and soft cap must
+    reach the kernel (a regression dropping the kwargs would un-mask packed
+    sequences while direct-kernel tests stay green)."""
+    from deepspeed_tpu.ops.pallas import flash_attention as fa
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+    from deepspeed_tpu.ops.attention import dot_product_attention
+
+    monkeypatch.setattr(fa, "is_compatible", lambda: True)
+    fk.set_interpret(True)
+    fk.set_block_sizes(64, 64)
+    try:
+        rng = np.random.default_rng(5)
+        b, s, h, d = 2, 128, 4, 64
+        q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+        seg = np.zeros((b, s), np.int32)
+        seg[:, 64:] = 1
+        seg = jnp.asarray(seg)
+        out = fa.flash_attention(q, k, v, causal=True, segment_ids=seg,
+                                 logits_soft_cap=25.0)
+        ref = dot_product_attention(q, k, v, causal=True, segment_ids=seg,
+                                    logits_soft_cap=25.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        # distinguishable from the unmasked result: the forwarding matters
+        plain = dot_product_attention(q, k, v, causal=True)
+        assert not np.allclose(np.asarray(out), np.asarray(plain), atol=1e-3)
+    finally:
+        fk.set_block_sizes(None, None)
+        fk.set_interpret(False)

@@ -1,0 +1,109 @@
+"""Block-sparse attention patterns + random-LTD tests (reference
+ops/sparse_attention/, runtime/data_pipeline/data_routing/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.data import (
+    RandomLTDScheduler,
+    random_ltd_layer,
+    sample_kept_indices,
+)
+from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    block_sparse_attention,
+)
+
+
+def _qkv(b=2, s=128, hq=4, hkv=2, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(b, s, hq, d)), jnp.float32),
+        jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32),
+        jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32),
+    )
+
+
+def test_dense_layout_matches_reference_attention():
+    q, k, v = _qkv()
+    out = block_sparse_attention(q, k, v, DenseSparsityConfig(block=32), causal=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fixed_layout_properties():
+    cfg = FixedSparsityConfig(block=16, num_local_blocks=4, num_global_blocks=1)
+    layout = cfg.make_layout(128)  # 8 blocks
+    assert layout.shape == (8, 8)
+    # every block sees its own window
+    assert layout[0, :4].all() and layout[5, 4:8].all()
+    # global columns visible to all rows
+    assert layout[:, 3].all() and layout[:, 7].all()
+    # sparse overall
+    assert layout.mean() < 0.8
+
+
+def test_bigbird_and_longformer_layouts():
+    bb = BigBirdSparsityConfig(block=16, num_random_blocks=1,
+                               num_sliding_window_blocks=3, num_global_blocks=1)
+    lb = bb.make_layout(256)
+    assert np.diag(lb).all()          # sliding window includes self
+    assert lb[0, :].all() and lb[:, 0].all()  # global block
+    lf = BSLongformerSparsityConfig(block=16, num_sliding_window_blocks=3,
+                                    global_block_indices=(0,)).make_layout(256)
+    assert np.diag(lf).all() and lf[:, 0].all()
+    assert lf.mean() < 0.5            # actually sparse
+
+
+def test_sparse_attention_masks_work():
+    """Tokens outside the layout must not influence the output."""
+    q, k, v = _qkv(s=128)
+    cfg = BSLongformerSparsityConfig(block=16, num_sliding_window_blocks=1,
+                                     global_block_indices=())
+    out = block_sparse_attention(q, k, v, cfg, causal=True)
+    # window of 1 block + causal == block-diagonal causal attention: first
+    # block rows must equal plain causal attention restricted to the block
+    ref = dot_product_attention(q[:, :16], k[:, :16], v[:, :16], causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :16]), np.asarray(ref), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# random-LTD
+# ---------------------------------------------------------------------------
+def test_random_ltd_scheduler_ramp():
+    sched = RandomLTDScheduler(start_tokens=32, seq_len=128, total_steps=100,
+                               granularity=16)
+    ks = [sched.update_seq(s) for s in (0, 25, 50, 100, 200)]
+    assert ks[0] == 32 and ks[-1] == 128
+    assert all(k % 16 == 0 for k in ks)
+    assert sorted(ks) == ks
+    sd = sched.state_dict()
+    sched2 = RandomLTDScheduler(32, 128, 100)
+    sched2.load_state_dict(sd)
+    assert sched2.get_current_seq() == ks[-1]
+
+
+def test_random_ltd_layer_subset_semantics():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 64, 8)), jnp.float32)
+    marker = lambda t: t + 100.0  # visible change on processed tokens
+
+    out = random_ltd_layer(x, marker, jax.random.PRNGKey(0), kept=16)
+    changed = np.abs(np.asarray(out) - np.asarray(x)).sum(-1) > 1.0
+    assert (changed.sum(axis=1) == 16).all()  # exactly kept tokens processed
+    # kept >= seq: full pass-through to the layer
+    out_full = random_ltd_layer(x, marker, jax.random.PRNGKey(0), kept=64)
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(x) + 100.0)
+
+
+def test_sample_kept_indices_sorted_unique():
+    idx = np.asarray(sample_kept_indices(jax.random.PRNGKey(1), 4, 64, 16))
+    assert idx.shape == (4, 16)
+    for row in idx:
+        assert (np.diff(row) > 0).all()  # sorted, unique
+        assert row.min() >= 0 and row.max() < 64
