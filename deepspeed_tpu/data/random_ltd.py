@@ -39,7 +39,8 @@ class RandomLTDScheduler:
         self.seq_len = seq_len
         self.total_steps = total_steps
         self.granularity = granularity
-        self.current = start_tokens
+        # quantized from the start: every kept count is a compile bucket
+        self.current = max(start_tokens - start_tokens % granularity, granularity)
 
     def get_current_seq(self) -> int:
         return self.current

@@ -57,12 +57,15 @@ def dot_product_attention(
     kv_segment_ids: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
+    attn_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Reference scaled-dot-product attention.
 
     q: [b, sq, hq, d];  k/v: [b, skv, hkv, d]  (hkv divides hq — GQA).
     Softmax is computed in fp32 regardless of input dtype (the reference's
     inference softmax kernels do the same for stability).
+    ``attn_mask`` [sq, skv] bool composes with causal/segment masking
+    (block-sparse layouts route through here, ops/sparse_attention.py).
     """
     in_dtype = q.dtype
     hq, hkv = q.shape[2], k.shape[2]
@@ -81,6 +84,8 @@ def dot_product_attention(
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         allowed = segment_ids[:, None, :, None] == kv_seg[:, None, None, :]
         logits = jnp.where(allowed, logits, jnp.finfo(jnp.float32).min)
+    if attn_mask is not None:
+        logits = jnp.where(attn_mask[None, None], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(in_dtype), v)
     return out

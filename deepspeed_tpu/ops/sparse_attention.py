@@ -24,11 +24,11 @@ import numpy as np
 
 @dataclass
 class SparsityConfig:
-    """Base (reference sparsity_config.py:12): block size + head behaviour."""
+    """Base (reference sparsity_config.py:12): the block size + pattern.
+    All heads share one layout (the reference's different_layout_per_head
+    variants are not carried over)."""
 
-    num_heads: int = 1
     block: int = 64
-    different_layout_per_head: bool = False  # layouts are per-pattern here
 
     def make_layout(self, seq_len: int) -> np.ndarray:
         """[n_blocks, n_blocks] bool — override per pattern."""
@@ -128,29 +128,24 @@ def block_sparse_attention(
     config: SparsityConfig,
     causal: bool = True,
     scale: Optional[float] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
 ):
     """[b, s, h, d] attention restricted to the config's block layout.
 
-    The block layout expands to an element mask fused into the softmax; with
-    causal=True the effective mask is layout AND causal (the reference's
-    triton kernels compose the same way).
+    Delegates to ``dot_product_attention`` with the layout expanded to an
+    element mask, so segments/soft-cap/GQA behave identically to the rest of
+    the stack.  NOTE: compute and memory are DENSE (masked softmax) — the
+    block layout controls semantics, not cost; for actual long-sequence
+    memory savings use the flash kernel (causal) or ring attention.  A
+    block-skipping Pallas variant is the open item.
     """
-    from .attention import make_causal_mask, repeat_kv
+    from .attention import dot_product_attention
 
-    b, s, hq, d = q.shape
+    s = q.shape[1]
     layout = jnp.asarray(config.make_layout(s))
     elem = jnp.repeat(jnp.repeat(layout, config.block, 0), config.block, 1)
-    in_dtype = q.dtype
-    hkv = k.shape[2]
-    k = repeat_kv(k, hq // hkv)
-    v = repeat_kv(v, hq // hkv)
-    scale = scale if scale is not None else float(d) ** -0.5
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    mask = elem
-    if causal:
-        mask = jnp.logical_and(mask, make_causal_mask(s, s) >= 0)
-    logits = jnp.where(mask[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(in_dtype), v)
+    return dot_product_attention(
+        q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
+        logits_soft_cap=logits_soft_cap, attn_mask=elem,
+    )

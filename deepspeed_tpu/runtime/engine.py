@@ -662,12 +662,29 @@ class DeepSpeedTpuEngine:
 
         def call(state: TrainState, batch_, rng):
             loss, grads, gnorm = jit_grad(state.params, batch_, rng, state.step)
+            # start every grad leaf's D2H copy before blocking on the norm:
+            # transfers run while we wait and while early leaves update
+            for leaf in jax.tree_util.tree_leaves(grads):
+                try:
+                    leaf.copy_to_host_async()
+                except AttributeError:
+                    pass
             gn = float(gnorm)
             coef = min(1.0, clip / (gn + 1e-6)) if clip and clip > 0 else 1.0
             lr = float(self.lr_schedule_fn(state.step))
             step_num = int(state.step) + 1
-            grads_host = jax.tree_util.tree_map(np.asarray, grads)
-            masters = self._nvme_opt.step(grads_host, lr, step_num, coef)
+            # per-leaf H2D uploads begin the moment each master is updated,
+            # overlapping the remaining host Adam walk (reference
+            # pipelined_optimizer_swapper overlap, weak #7)
+            device_masters: list = [None] * self._nvme_opt.num_leaves
+
+            def on_leaf(i, master):
+                device_masters[i] = jax.device_put(master)
+
+            self._nvme_opt.step(grads, lr, step_num, coef, on_leaf=on_leaf)
+            masters = jax.tree_util.tree_unflatten(
+                self._nvme_opt.treedef, device_masters
+            )
             new_state = TrainState(
                 step=state.step + 1,
                 params=upload(masters),

@@ -60,6 +60,14 @@ class NVMeOptimizer:
         self._names: List[str] = []
         self._treedef = None
 
+    @property
+    def num_leaves(self) -> int:
+        return len(self._names)
+
+    @property
+    def treedef(self):
+        return self._treedef
+
     def init(self, params) -> None:
         """Write fp32 masters and zeroed Adam moments for every leaf."""
         leaves, self._treedef = jax.tree_util.tree_flatten(params)
@@ -82,21 +90,38 @@ class NVMeOptimizer:
         for part in ("master", "m", "v"):
             self.swapper.prefetch(f"{name}.{part}")
 
-    def step(self, grads, lr: float, step_num: int, clip_coef: float = 1.0):
+    def step(
+        self,
+        grads,
+        lr: float,
+        step_num: int,
+        clip_coef: float = 1.0,
+        on_leaf=None,
+        prefetch_depth: int = 2,
+    ):
         """Apply one AdamW step; returns the updated fp32 master pytree.
 
         ``clip_coef`` folds global-norm clipping (computed on device) into the
         gradient scale.  ``step_num`` drives bias correction — it is owned by
         the caller so every leaf sees the same step.
+
+        Pipelining (reference pipelined_optimizer_swapper.py): ``prefetch_depth``
+        leaves' (master, m, v) reads stream in ahead of the update walk,
+        swap_out writes are async (the AIO thread pool drains them), and
+        ``on_leaf(i, master)`` fires as each leaf finishes — the engine uses
+        it to start that leaf's async host->device upload so H2D overlaps the
+        remaining host Adam work.  Grad leaves may be jax device arrays whose
+        D2H copies were started asynchronously by the caller.
         """
         grad_leaves = jax.tree_util.tree_leaves(grads)
         assert len(grad_leaves) == len(self._names), "grad tree mismatch"
-        if self._names:
-            self._prefetch(self._names[0])
+        for j in range(min(prefetch_depth, len(self._names))):
+            self._prefetch(self._names[j])
+        build_tree = on_leaf is None  # callback consumers own the results
         out: List[np.ndarray] = []
         for i, (name, g) in enumerate(zip(self._names, grad_leaves)):
-            if i + 1 < len(self._names):
-                self._prefetch(self._names[i + 1])  # overlap next leaf's reads
+            if i + prefetch_depth < len(self._names):
+                self._prefetch(self._names[i + prefetch_depth])
             master = self.swapper.swap_in(f"{name}.master")
             m = self.swapper.swap_in(f"{name}.m")
             v = self.swapper.swap_in(f"{name}.v")
@@ -109,7 +134,12 @@ class NVMeOptimizer:
             self.swapper.swap_out(f"{name}.master", master)
             self.swapper.swap_out(f"{name}.m", m)
             self.swapper.swap_out(f"{name}.v", v)
-            out.append(master)
+            if on_leaf is not None:
+                on_leaf(i, master)
+            if build_tree:
+                out.append(master)
+        if not build_tree:
+            return None
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     def export_masters(self):
