@@ -312,6 +312,8 @@ class FlopsProfiler:
                     "train FLOPS achieved: "
                     f"{flops_to_string(6 * total_macs / self._duration)}"
                 )
+        # NOTE: XLA cost analysis counts loop (scan) bodies ONCE, not per
+        # trip — the scheduled-FLOPs line undercounts scanned layers/gas
         for k, label in (
             ("flops", "XLA scheduled FLOPs:  "),
             ("bytes_accessed", "XLA bytes accessed:   "),

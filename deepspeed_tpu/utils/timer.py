@@ -34,7 +34,7 @@ class _Timer:
         if self.started:
             return
         if sync_obj is not None:
-            _block(sync_obj)
+            _block(sync_obj, hard=True)
         self._start = time.perf_counter()
         self.started = True
 
@@ -42,7 +42,7 @@ class _Timer:
         if not self.started:
             return
         if sync_obj is not None:
-            _block(sync_obj)
+            _block(sync_obj, hard=True)
         if record:
             duration = time.perf_counter() - self._start
             self._elapsed += duration
@@ -70,11 +70,22 @@ class _Timer:
         return (self._elapsed / self._count * 1000.0) if self._count else 0.0
 
 
-def _block(obj):
+def _block(obj, hard: bool = False):
+    """Device sync.  ``hard`` additionally forces a 1-element host fetch:
+    block_until_ready alone is not a reliable fence on every backend (the
+    axon tunnel returns immediately).  Hard syncs serialize dispatch, so
+    only measurement paths (wall_clock_breakdown, the flops profiler)
+    request them — the throughput timer stays a soft fence."""
     try:
         import jax
 
         jax.block_until_ready(obj)
+        if hard:
+            import numpy as np
+
+            leaves = jax.tree_util.tree_leaves(obj)
+            if leaves and hasattr(leaves[0], "ravel"):
+                np.asarray(leaves[0].ravel()[0])
     except Exception:
         pass
 
