@@ -75,28 +75,40 @@ class InferenceEngineV2:
             dtype=cfg.dtype,
         )
         self._rng = jax.random.PRNGKey(seed)
-        # static-batch decode tensors
-        self.block_tables = jnp.full((max_seqs, self.max_pages), -1, jnp.int32)
+        # host-side block-table mirror: rows update as pure numpy writes and
+        # upload ONCE per tick — per-sequence device .at[].set calls cost one
+        # dispatch each, which dominated decode latency
+        self._tables_np = np.full((max_seqs, self.max_pages), -1, np.int32)
 
         # params are explicit jit arguments — closing over them would inline
         # every weight into the HLO as a constant (huge programs, no donation)
         cfg_ = self.cfg
 
-        def packed_impl(params, tokens, seg, pos, page_idx, page_off, last_idx, kv):
-            return model_runner.prefill_packed(
+        # only the device-relevant sampling triple is static — hashing the
+        # whole SamplingParams would recompile on max_new_tokens/stop_token
+        def packed_impl(params, tokens, seg, pos, page_idx, page_off, last_idx,
+                        kv, rng, sampling_triple):
+            logits, kv = model_runner.prefill_packed(
                 params, cfg_, tokens, seg, pos, page_idx, page_off, last_idx, kv
             )
+            # sampling fused into the dispatch: the decode loop never makes a
+            # second device round trip per tick
+            t, k, p = sampling_triple
+            return sample(logits, SamplingParams(t, k, p), rng), kv
 
-        def decode_impl(params, tokens, seq_lens, block_tables, active, kv):
-            return model_runner.decode_step(
+        def decode_impl(params, tokens, seq_lens, block_tables, active, kv,
+                        rng, sampling_triple):
+            logits, kv = model_runner.decode_step(
                 params, cfg_, tokens, seq_lens, block_tables, active, kv
             )
+            t, k, p = sampling_triple
+            return sample(logits, SamplingParams(t, k, p), rng), kv
 
         self._packed_prefill_jit = self._wrap_offload(
-            jax.jit(packed_impl, donate_argnums=(7,))
+            jax.jit(packed_impl, donate_argnums=(7,), static_argnums=(9,))
         )
         self._decode_jit = self._wrap_offload(
-            jax.jit(decode_impl, donate_argnums=(5,))
+            jax.jit(decode_impl, donate_argnums=(5,), static_argnums=(7,))
         )
 
     # -- ZeRO-Inference helpers ---------------------------------------------
@@ -246,13 +258,13 @@ class InferenceEngineV2:
             page_off[cur : cur + n] = flat % self.block_size
             last_idx[j] = cur + n - 1
             cur += n
-        logits, self.kv = self._packed_prefill_jit(
+        self._rng, sub = jax.random.split(self._rng)
+        sampled, self.kv = self._packed_prefill_jit(
             self.params, jnp.asarray(tokens), jnp.asarray(seg), jnp.asarray(pos),
             jnp.asarray(page_idx), jnp.asarray(page_off), jnp.asarray(last_idx),
-            self.kv,
+            self.kv, sub, (sampling.temperature, sampling.top_k, sampling.top_p),
         )
-        self._rng, sub = jax.random.split(self._rng)
-        next_tokens = np.asarray(sample(logits, sampling, sub))
+        next_tokens = np.asarray(sampled)
         for j, s in enumerate(seqs):
             tok = int(next_tokens[j])
             s.seen_tokens = len(s.tokens)
@@ -261,9 +273,9 @@ class InferenceEngineV2:
             out[s.uid] = tok
 
     def _set_block_table(self, seq) -> None:
-        row = np.full(self.max_pages, -1, np.int32)
+        row = self._tables_np[seq.slot]
+        row[:] = -1
         row[: len(seq.blocks)] = seq.blocks
-        self.block_tables = self.block_tables.at[seq.slot].set(jnp.asarray(row))
 
     def step(self, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
         """One batched decode tick over all active sequences; returns the
@@ -282,12 +294,15 @@ class InferenceEngineV2:
             tokens[s.slot] = s.tokens[-1]
             seq_lens[s.slot] = s.cur_len - 1  # KV position of the new token
             active[s.slot] = True
-        logits, self.kv = self._decode_jit(
-            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
-            self.block_tables, jnp.asarray(active), self.kv,
-        )
         self._rng, sub = jax.random.split(self._rng)
-        next_tokens = np.asarray(sample(logits, sampling, sub))
+        sampled, self.kv = self._decode_jit(
+            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+            # copy: jnp.asarray can alias the numpy mirror zero-copy on CPU,
+            # and the mirror mutates in place next tick
+            jnp.array(self._tables_np), jnp.asarray(active), self.kv,
+            sub, (sampling.temperature, sampling.top_k, sampling.top_p),
+        )
+        next_tokens = np.asarray(sampled)
         out = {}
         for s in active_seqs:
             tok = int(next_tokens[s.slot])

@@ -85,7 +85,16 @@ def _block(obj, hard: bool = False):
 
             leaves = jax.tree_util.tree_leaves(obj)
             if leaves and hasattr(leaves[0], "ravel"):
-                np.asarray(leaves[0].ravel()[0])
+                try:
+                    np.asarray(leaves[0].ravel()[0])
+                except Exception as e:  # e.g. non-addressable sharded arrays
+                    from .logging import warning_once
+
+                    warning_once(
+                        f"hard timer fence fell back to block_until_ready "
+                        f"({type(e).__name__}); measured times may be "
+                        "dispatch-only on backends with unreliable fences"
+                    )
     except Exception:
         pass
 
