@@ -44,15 +44,25 @@ def set_interpret(value: bool) -> None:
     _INTERPRET = bool(value)
 
 
-# Tunable block sizes (q, kv); None = auto.  set_block_sizes lets the
-# autotuner (deepspeed_tpu/autotuning) pick per-chip values.
+# Tunable block sizes (q, kv); None = auto.  set_block_sizes exists for
+# per-chip sweeps/experiments; the backward kernels may use their own sizes
+# (their VMEM footprint differs: two extra operand streams + fp32
+# accumulators), though the mirrored default measured fastest end-to-end.
 _BLOCK_Q: Optional[int] = None
 _BLOCK_K: Optional[int] = None
+_BLOCK_Q_BWD: Optional[int] = None
+_BLOCK_K_BWD: Optional[int] = None
 
 
-def set_block_sizes(bq: Optional[int] = None, bk: Optional[int] = None) -> None:
-    global _BLOCK_Q, _BLOCK_K
+def set_block_sizes(
+    bq: Optional[int] = None,
+    bk: Optional[int] = None,
+    bq_bwd: Optional[int] = None,
+    bk_bwd: Optional[int] = None,
+) -> None:
+    global _BLOCK_Q, _BLOCK_K, _BLOCK_Q_BWD, _BLOCK_K_BWD
     _BLOCK_Q, _BLOCK_K = bq, bk
+    _BLOCK_Q_BWD, _BLOCK_K_BWD = bq_bwd, bk_bwd
 
 
 def _pick_block(s: int, preferred=(1024, 512, 256, 128), override: Optional[int] = None):
@@ -70,6 +80,18 @@ def _blocks(s: int):
     return (
         _pick_block(s, override=_BLOCK_Q),
         _pick_block(s, override=_BLOCK_K),
+    )
+
+
+def _blocks_bwd(s: int):
+    # Defaults mirror the forward: bwd (256, 2048) is 2x faster in ISOLATED
+    # kernel microbenchmarks (v5e, hd=128, s=4096) but regresses the full
+    # fused train step ~4% (VMEM/scheduling interaction with the selective-
+    # remat recompute), so end-to-end wins keep the mirrored default; the
+    # overrides stay for per-model autotuning.
+    return (
+        _pick_block(s, override=_BLOCK_Q_BWD if _BLOCK_Q_BWD else _BLOCK_Q),
+        _pick_block(s, override=_BLOCK_K_BWD if _BLOCK_K_BWD else _BLOCK_K),
     )
 
 
@@ -312,7 +334,7 @@ def _dkv_kernel(*refs, scale, bq, bk, has_seg, soft_cap):
 def _bwd(scale, soft_cap, res, do):
     q, k_rep, v_rep, qseg, kseg, out, lse = res  # kv repeated to hq heads
     bh, s, d = q.shape
-    bq, bk = _blocks(s)
+    bq, bk = _blocks_bwd(s)
     has_seg = qseg is not None
     hq_pb = bh // qseg.shape[0] if has_seg else 1
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,

@@ -166,3 +166,26 @@ def test_flash_attention_dispatcher_forwards_kwargs(monkeypatch):
     finally:
         fk.set_block_sizes(None, None)
         fk.set_interpret(False)
+
+
+def test_flash_bwd_block_override_parity():
+    """Backward-specific block sizes produce identical gradients."""
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+    from deepspeed_tpu.ops.pallas.flash_kernel import pallas_flash_attention
+
+    rng = np.random.default_rng(7)
+    b, s, h, d = 1, 128, 2, 64
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    loss = lambda q: pallas_flash_attention(q, k, v, causal=True).sum()
+    fk.set_interpret(True)
+    try:
+        fk.set_block_sizes(64, 64)
+        g_ref = jax.grad(loss)(q)
+        fk.set_block_sizes(64, 64, bq_bwd=32, bk_bwd=128)
+        g_alt = jax.grad(loss)(q)
+        np.testing.assert_allclose(np.asarray(g_alt), np.asarray(g_ref), atol=2e-5)
+    finally:
+        fk.set_block_sizes(None, None)
+        fk.set_interpret(False)
