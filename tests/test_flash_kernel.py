@@ -178,14 +178,18 @@ def test_flash_bwd_block_override_parity():
     q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
     k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
-    loss = lambda q: pallas_flash_attention(q, k, v, causal=True).sum()
+    # all three grads: dq AND dk/dv (the dkv kernel's transposed grid is
+    # where a bq!=bk bug would hide; q-only grads let XLA prune it)
+    loss = lambda q, k, v: pallas_flash_attention(q, k, v, causal=True).sum()
+    gfn = jax.grad(loss, argnums=(0, 1, 2))
     fk.set_interpret(True)
     try:
         fk.set_block_sizes(64, 64)
-        g_ref = jax.grad(loss)(q)
+        g_ref = gfn(q, k, v)
         fk.set_block_sizes(64, 64, bq_bwd=32, bk_bwd=128)
-        g_alt = jax.grad(loss)(q)
-        np.testing.assert_allclose(np.asarray(g_alt), np.asarray(g_ref), atol=2e-5)
+        g_alt = gfn(q, k, v)
+        for a, b in zip(g_alt, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
     finally:
         fk.set_block_sizes(None, None)
         fk.set_interpret(False)
