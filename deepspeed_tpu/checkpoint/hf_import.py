@@ -234,6 +234,7 @@ def load_hf_checkpoint_sharded(
     mesh,
     cfg: Optional[TransformerConfig] = None,
     dtype=jnp.float32,
+    store: Optional["_LazyStore"] = None,
 ) -> Tuple[Params, TransformerConfig]:
     """Streamed safetensors import: every leaf is assembled **shard-by-shard**
     via ``jax.make_array_from_callback`` against the sharding plan, reading
@@ -245,7 +246,7 @@ def load_hf_checkpoint_sharded(
         hf_cfg = json.load(fh)
     if cfg is None:
         cfg = config_from_hf(hf_cfg)
-    store = _LazyStore(model_dir)
+    store = store if store is not None else _LazyStore(model_dir)
     L = cfg.num_layers
     d, f_, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd

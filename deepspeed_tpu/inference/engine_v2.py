@@ -21,7 +21,7 @@ import numpy as np
 from ..models.transformer import TransformerConfig
 from ..utils.logging import log_dist
 from . import model_runner
-from .paged import init_paged_cache
+from .paged import init_paged_cache, kv_pool_pspec
 from .ragged import StateManager
 from .sampling import SamplingParams, sample
 
@@ -48,6 +48,7 @@ class InferenceEngineV2:
         prefill_budget: Optional[int] = None,
         seed: int = 0,
         offload_weights: bool = False,
+        grid=None,
     ):
         self.cfg = cfg
         # ZeRO-Inference (reference docs/_posts/2022-09-10-zero-inference.md,
@@ -56,6 +57,41 @@ class InferenceEngineV2:
         # device memory to one layer's working set
         self._offload_weights = offload_weights
         self._offload_mode: Optional[str] = None
+        # Tensor-parallel serving (reference inference/v2/engine_v2.py:93
+        # _initialize_tp_group + model_implementations/sharding/): params go
+        # into AutoTP shardings, the KV pool shards on kv heads, and the
+        # paged-attention kernel runs per-shard under shard_map.  A 70B-class
+        # model that trains under zero.Init serves the same way: sharded.
+        self.grid = grid
+        self._mesh = None
+        tp = grid.spec.model if grid is not None else 1
+        if grid is not None and tp > 1:
+            if offload_weights:
+                raise ValueError(
+                    "offload_weights and tensor-parallel serving are "
+                    "exclusive: ZeRO-Inference streams host-resident weights, "
+                    "TP shards them in HBM — pick one capacity strategy"
+                )
+            if cfg.num_heads % tp != 0:
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} must be divisible by the "
+                    f"model axis ({tp}) for TP serving"
+                )
+            import jax.tree_util as jtu
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.auto_tp import infer_tp_rules
+            from ..runtime.zero import match_rules, path_str
+
+            self._mesh = grid.mesh
+            rules = infer_tp_rules(params, tp, vocab_size=cfg.vocab_size)
+            self._param_shardings = jtu.tree_map_with_path(
+                lambda kp, leaf: NamedSharding(
+                    grid.mesh, match_rules(path_str(kp), tuple(leaf.shape), rules)
+                ),
+                params,
+            )
+            params = jax.device_put(params, self._param_shardings)
         if offload_weights:
             params = self._to_host(params)
         self.params = params
@@ -74,6 +110,13 @@ class InferenceEngineV2:
             cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.hd,
             dtype=cfg.dtype,
         )
+        self._kv_shardings = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+
+            kv_sh = NamedSharding(self._mesh, kv_pool_pspec(cfg.num_kv_heads, tp))
+            self._kv_shardings = (kv_sh, kv_sh)
+            self.kv = jax.device_put(self.kv, self._kv_shardings)
         self._rng = jax.random.PRNGKey(seed)
         # host-side block-table mirror: rows update as pure numpy writes and
         # upload ONCE per tick — per-sequence device .at[].set calls cost one
@@ -96,20 +139,40 @@ class InferenceEngineV2:
             t, k, p = sampling_triple
             return sample(logits, SamplingParams(t, k, p), rng), kv
 
+        mesh_ = self._mesh
+
         def decode_impl(params, tokens, seq_lens, block_tables, active, kv,
                         rng, sampling_triple):
             logits, kv = model_runner.decode_step(
-                params, cfg_, tokens, seq_lens, block_tables, active, kv
+                params, cfg_, tokens, seq_lens, block_tables, active, kv,
+                mesh=mesh_,
             )
             t, k, p = sampling_triple
             return sample(logits, SamplingParams(t, k, p), rng), kv
 
-        self._packed_prefill_jit = self._wrap_offload(
-            jax.jit(packed_impl, donate_argnums=(7,), static_argnums=(9,))
-        )
-        self._decode_jit = self._wrap_offload(
-            jax.jit(decode_impl, donate_argnums=(5,), static_argnums=(7,))
-        )
+        if self._mesh is not None:
+            # pin the result shardings so the KV pool STAYS sharded across
+            # ticks (donation then reuses the buffers in place) and sampled
+            # tokens come back replicated for the host loop
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self._mesh, P())
+            out_sh = (rep, self._kv_shardings)
+            self._packed_prefill_jit = jax.jit(
+                packed_impl, donate_argnums=(7,), static_argnums=(9,),
+                out_shardings=out_sh,
+            )
+            self._decode_jit = jax.jit(
+                decode_impl, donate_argnums=(5,), static_argnums=(7,),
+                out_shardings=out_sh,
+            )
+        else:
+            self._packed_prefill_jit = self._wrap_offload(
+                jax.jit(packed_impl, donate_argnums=(7,), static_argnums=(9,))
+            )
+            self._decode_jit = self._wrap_offload(
+                jax.jit(decode_impl, donate_argnums=(5,), static_argnums=(7,))
+            )
 
     # -- ZeRO-Inference helpers ---------------------------------------------
     @staticmethod
@@ -169,7 +232,48 @@ class InferenceEngineV2:
     @classmethod
     def from_hf(cls, model_dir: str, dtype=None, **kw) -> "InferenceEngineV2":
         """Build from an HF safetensors checkpoint directory — the analogue
-        of the reference's ``build_hf_engine`` (inference/v2/engine_factory.py:69)."""
+        of the reference's ``build_hf_engine`` (inference/v2/engine_factory.py:69).
+
+        With ``grid=`` (model axis > 1) the checkpoint is streamed
+        shard-by-shard straight into its TP shardings, so a 70B-class model
+        never materializes unsharded on any host or device — the serving
+        counterpart of zero.Init's sharded construction."""
+        grid = kw.get("grid")
+        if grid is not None and grid.spec.model > 1:
+            import functools
+            import json
+            import os
+
+            from ..checkpoint.hf_import import (
+                _LazyStore,
+                config_from_hf,
+                load_hf_checkpoint_sharded,
+            )
+            from ..config.config import ZeroConfig
+            from ..models.transformer import init_params
+            from ..parallel.auto_tp import infer_tp_rules
+            from ..runtime.zero import plan_sharding
+
+            with open(os.path.join(model_dir, "config.json")) as fh:
+                cfg = config_from_hf(json.load(fh))
+            if dtype is not None:
+                cfg = cfg.replace(dtype=dtype)
+            # same tie fallback the loader applies — pre-checked here (with a
+            # shared store, scanned once) so the plan's shapes match the tree
+            store = _LazyStore(model_dir)
+            if not cfg.tie_embeddings and "lm_head.weight" not in store:
+                cfg = cfg.replace(tie_embeddings=True)
+            shapes = jax.eval_shape(
+                functools.partial(init_params, cfg=cfg, dtype=cfg.dtype),
+                jax.random.PRNGKey(0),
+            )
+            rules = infer_tp_rules(shapes, grid.spec.model, vocab_size=cfg.vocab_size)
+            plan = plan_sharding(shapes, ZeroConfig(stage=0), grid.spec, tp_rules=rules)
+            params, cfg = load_hf_checkpoint_sharded(
+                model_dir, plan, grid.mesh, cfg=cfg, dtype=cfg.dtype, store=store
+            )
+            return cls(params, cfg, **kw)
+
         from ..checkpoint.hf_import import load_hf_checkpoint
 
         params, cfg = load_hf_checkpoint(model_dir)

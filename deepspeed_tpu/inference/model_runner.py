@@ -173,6 +173,7 @@ def decode_step(
     block_tables: jnp.ndarray,  # [B, P] int32
     active: jnp.ndarray,  # [B] bool
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+    mesh=None,  # TP serving: shard_map the paged attention over 'model'
 ):
     """One batched decode tick: returns (logits [B, v], new caches)."""
     b = tokens.shape[0]
@@ -200,7 +201,7 @@ def decode_step(
         )
         attn = paged_attention_decode(
             q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
-            logits_soft_cap=cfg.logits_soft_cap,
+            logits_soft_cap=cfg.logits_soft_cap, mesh=mesh,
         )
         attn = attn.reshape(b, 1, -1) @ lw["attn"]["wo"]
         x = x + attn.astype(x.dtype)

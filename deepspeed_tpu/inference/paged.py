@@ -63,7 +63,7 @@ def write_decode_kv(cache_layer, kv, block_table, positions, active):
 
 def paged_attention_decode(
     q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
-    logits_soft_cap=None,
+    logits_soft_cap=None, mesh=None,
 ):
     """Single-token attention against paged KV.
 
@@ -75,7 +75,30 @@ def paged_attention_decode(
     Dispatches to the Pallas kernel (ops/pallas/paged_attention.py) on TPU —
     per-sequence page routing + length-bounded work; this jnp gather body is
     the fallback and ground truth (it reads all ``max_pages`` densely).
+
+    With ``mesh`` (tensor-parallel serving — reference
+    ``inference/v2/model_implementations/sharding/attn.py`` shards heads
+    across the TP group): the call runs under ``shard_map`` on the ``model``
+    axis, q split on query heads and the KV pool split on kv heads (kv
+    replicated when hkv doesn't divide the axis).  A Pallas call cannot be
+    partitioned by GSPMD — without the explicit map XLA would all-gather the
+    whole block pool to every shard.
     """
+    if mesh is not None and _model_axis_size(mesh) > 1:
+        return _paged_attention_decode_tp(
+            q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh,
+            scale=scale, logits_soft_cap=logits_soft_cap,
+        )
+    return _paged_attention_decode_local(
+        q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=scale,
+        logits_soft_cap=logits_soft_cap,
+    )
+
+
+def _paged_attention_decode_local(
+    q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
+    logits_soft_cap=None,
+):
     from ..ops.pallas import on_tpu
     from ..ops.pallas import paged_attention as pk
 
@@ -87,6 +110,92 @@ def paged_attention_decode(
         q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=scale,
         logits_soft_cap=logits_soft_cap,
     )
+
+
+def _model_axis_size(mesh) -> int:
+    from ..parallel.topology import MODEL_AXIS
+
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(MODEL_AXIS, 1)
+
+
+def kv_pool_pspec(num_kv_heads: int, tp: int):
+    """PartitionSpec for a [L, nb, bs, hkv, hd] block pool: kv heads shard on
+    ``model`` when divisible, otherwise the pool replicates (GQA, hkv < tp)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.topology import MODEL_AXIS
+
+    head_axis = MODEL_AXIS if (tp > 1 and num_kv_heads % tp == 0) else None
+    return P(None, None, None, head_axis, None)
+
+
+def _paged_attention_decode_tp(
+    q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh, scale=None,
+    logits_soft_cap=None,
+):
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.topology import MODEL_AXIS
+
+    try:
+        from jax import shard_map as _sm  # jax >= 0.8 (check_vma kwarg)
+
+        def shard_map(f, **kw):
+            kw["check_vma"] = kw.pop("check_rep")
+            return _sm(f, **kw)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    tp = _model_axis_size(mesh)
+    b, hq, hd = q.shape
+    hkv = cache_k_layer.shape[2]
+    if hq % tp != 0:
+        raise ValueError(
+            f"model axis ({tp}) must divide num_heads ({hq}) for TP serving"
+        )
+    kv_sharded = hkv % tp == 0
+    kv_head_axis = MODEL_AXIS if kv_sharded else None
+    q_spec = P(None, MODEL_AXIS, None)
+    kv_spec = P(None, None, kv_head_axis, None)
+    local = functools.partial(
+        _paged_attention_decode_local, scale=scale, logits_soft_cap=logits_soft_cap
+    )
+    if kv_sharded:
+        # hq/hkv is integral, so the kv heads of q shard i are exactly kv
+        # shard i — local GQA ratio is preserved and no gather is needed
+        body = local
+    else:
+        def body(q_l, ck, cv, bt, sl):
+            # replicated pool (hkv < tp): each shard narrows the pool to its
+            # q heads' kv head(s) so the local body sees an aligned GQA
+            # problem — repeat_kv(hq_local // hkv) would be 0 when
+            # hkv > hq_local.  (A block-dim-sharded flash-decoding split
+            # would avoid the pool copy entirely; head narrowing keeps the
+            # paged kernel's per-page DMA untouched.)
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            hq_l = q_l.shape[1]
+            i = _jax.lax.axis_index(MODEL_AXIS)
+            if tp % hkv == 0:
+                # shard chunks nest inside kv groups: exactly ONE kv head per
+                # shard — one contiguous O(pool/hkv) slice, not a full-pool
+                # gather
+                ck_l = _jax.lax.dynamic_slice_in_dim(ck, i * hkv // tp, 1, axis=2)
+                cv_l = _jax.lax.dynamic_slice_in_dim(cv, i * hkv // tp, 1, axis=2)
+                return local(q_l, ck_l, cv_l, bt, sl)
+            g_heads = i * hq_l + _jnp.arange(hq_l)
+            kv_ids = g_heads * hkv // hq
+            return local(q_l, _jnp.take(ck, kv_ids, axis=2),
+                         _jnp.take(cv, kv_ids, axis=2), bt, sl)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P(None, None), P(None)),
+        out_specs=q_spec, check_rep=False,
+    )(q, cache_k_layer, cache_v_layer, block_table, seq_lens)
 
 
 def _paged_attention_decode_dense(
