@@ -91,7 +91,15 @@ class InferenceEngineV2:
                 ),
                 params,
             )
-            params = jax.device_put(params, self._param_shardings)
+            # from_hf streams the checkpoint straight into these shardings;
+            # leaf-wise skip keeps that a no-op (a blanket device_put of an
+            # already-sharded 70B tree would silently reshard any leaf where
+            # the plan and the raw rule mapping ever diverge)
+            params = jtu.tree_map(
+                lambda x, sh: x if getattr(x, "sharding", None) == sh
+                else jax.device_put(x, sh),
+                params, self._param_shardings,
+            )
         if offload_weights:
             params = self._to_host(params)
         self.params = params
@@ -168,10 +176,12 @@ class InferenceEngineV2:
             )
         else:
             self._packed_prefill_jit = self._wrap_offload(
-                jax.jit(packed_impl, donate_argnums=(7,), static_argnums=(9,))
+                jax.jit(packed_impl, donate_argnums=(7,), static_argnums=(9,)),
+                kv_rest_idx=6,
             )
             self._decode_jit = self._wrap_offload(
-                jax.jit(decode_impl, donate_argnums=(5,), static_argnums=(7,))
+                jax.jit(decode_impl, donate_argnums=(5,), static_argnums=(7,)),
+                kv_rest_idx=4,
             )
 
     # -- ZeRO-Inference helpers ---------------------------------------------
@@ -187,23 +197,36 @@ class InferenceEngineV2:
         except Exception:
             return params  # backend has no host memory space
 
-    def _wrap_offload(self, jitted):
+    def _wrap_offload(self, jitted, kv_rest_idx: int):
         """With offload_weights: feed host-resident params straight into jit
         (XLA streams them); backends that reject host operands fall back to
         staging a transient device copy per dispatch (same capability-probe
-        pattern as the training engine's _wrap_offload_step)."""
+        pattern as the training engine's _wrap_offload_step).
+
+        ``kv_rest_idx``: position of the donated KV pool within ``rest``.
+        While host-operand support is still unknown, the KV arg is defensively
+        copied before the host-mode attempt — the jit donates it, and a
+        rejection that surfaces at execution time (after donation) would
+        otherwise leave the staged retry dereferencing a deleted buffer."""
         if not self._offload_weights:
             return jitted
 
         def call(params, *rest):
             if self._offload_mode in (None, "host"):
+                probing = self._offload_mode is None
+                if probing:
+                    rest = list(rest)
+                    kv_live = rest[kv_rest_idx]
+                    rest[kv_rest_idx] = jax.tree_util.tree_map(
+                        jnp.copy, kv_live
+                    )
                 try:
                     out = jitted(params, *rest)
                     self._offload_mode = "host"
                     return out
                 except Exception as e:
                     msg = str(e).lower()
-                    if self._offload_mode == "host" or not any(
+                    if not probing or not any(
                         k in msg for k in ("memory kind", "memory_kind",
                                            "pinned_host", "memory space",
                                            "memory_space", "host memory")
@@ -214,6 +237,7 @@ class InferenceEngineV2:
                         "staging weights per dispatch"
                     )
                     self._offload_mode = "staged"
+                    rest[kv_rest_idx] = kv_live  # copy may be donated; restore
             # cross-memory-kind device_put is rejected on some backends:
             # stage through host RAM (the weights are host-resident anyway)
             dev = jax.tree_util.tree_map(

@@ -158,8 +158,8 @@ def paged_attention_decode_kernel(
             grid=(b,),
             in_specs=[
                 pl.BlockSpec((1, hq, hd), lambda bi, lens, tables: (bi, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.ANY),  # kv pools stay in HBM
-                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),  # kv pools stay in HBM
+                pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=pl.BlockSpec((1, hq, hd), lambda bi, lens, tables: (bi, 0, 0)),
             scratch_shapes=[

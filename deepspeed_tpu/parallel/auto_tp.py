@@ -100,6 +100,12 @@ def infer_tp_rules(
             continue
         lower = path.lower()
         if "bias" in lower or re.search(r"/b[qkv]$", path):
+            # a row-parallel layer's bias is applied AFTER the allreduce: it
+            # must replicate even when its size coincides with some
+            # column-parallel fan_out (common when hq*hd == d) — classify by
+            # the owning layer's path, not by size alone
+            if any(re.search(p, lower) for p in ROW_PATTERNS):
+                continue
             if col_out_sizes.get(shape[-1]) and divides(shape[-1]):
                 rules.append((f"^{re.escape(path)}$", P(MODEL_AXIS)))
     return rules

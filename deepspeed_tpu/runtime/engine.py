@@ -660,6 +660,12 @@ class DeepSpeedTpuEngine:
             out_shardings=self.param_shardings,
         )
 
+        # upload each master INTO its sharding: an unsharded device_put would
+        # commit every full fp32 master to device 0 before the upload jit
+        # reshards — a transient HBM spike proportional to the fp32 model
+        # size, on the path that exists because memory is tight
+        master_sh = jax.tree_util.tree_leaves(self.master_shardings_dev)
+
         def call(state: TrainState, batch_, rng):
             loss, grads, gnorm = jit_grad(state.params, batch_, rng, state.step)
             # start every grad leaf's D2H copy before blocking on the norm:
@@ -679,7 +685,7 @@ class DeepSpeedTpuEngine:
             device_masters: list = [None] * self._nvme_opt.num_leaves
 
             def on_leaf(i, master):
-                device_masters[i] = jax.device_put(master)
+                device_masters[i] = jax.device_put(master, master_sh[i])
 
             self._nvme_opt.step(grads, lr, step_num, coef, on_leaf=on_leaf)
             masters = jax.tree_util.tree_unflatten(
