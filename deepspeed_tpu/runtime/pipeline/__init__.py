@@ -1,3 +1,4 @@
+from .interpreter import ExecutionStats, interpret_inference, interpret_schedule  # noqa: F401
 from .module import LayerSpec, PipelineModule, partition_balanced, partition_layers  # noqa: F401
 from .pipelined import PipelinedCausalLM, pipeline_apply  # noqa: F401
 from .schedule import (  # noqa: F401

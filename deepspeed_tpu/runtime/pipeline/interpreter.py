@@ -1,0 +1,266 @@
+"""Eager instruction-interpreting pipeline executor.
+
+The reference's ``PipelineEngine`` (runtime/pipe/engine.py:61) executes
+``PipeSchedule`` instruction streams imperatively: a python dispatch table
+(``_INSTRUCTION_MAP``, engine.py:1307) maps each instruction to a method,
+p2p send/recv move activations between stage processes, and a fixed pool of
+``num_pipe_buffers()`` activation buffers bounds memory.
+
+This is the TPU repo's equivalent — an eager, host-driven interpreter that
+consumes the same ``TrainSchedule``/``InferenceSchedule`` objects
+(schedule.py).  All stages run in one process as cooperative coroutines:
+each stage holds its instruction list for the current step and a tiny
+round-robin scheduler executes instruction-by-instruction, blocking a stage
+whose ``Recv*`` has no data yet (a schedule whose send/recv pairing is wrong
+deadlocks here — the same property the reference's paired p2p enforces,
+schedule.py:184).  Mailbox deques stand in for p2p channels.
+
+Use it as the parity oracle and debugging executor for the fused XLA
+executor (``pipelined.py``): same math, observable step-by-step, buffer
+occupancy measurable.  It is NOT the performance path — ``pipeline_apply``
+is — but it proves the schedule objects are executable and that 1F1B's
+O(stages) live-buffer contract holds instruction-for-instruction.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .schedule import (
+    BackwardPass,
+    ForwardPass,
+    InferenceSchedule,
+    LoadMicroBatch,
+    OptimizerStep,
+    PipeSchedule,
+    RecvActivation,
+    RecvGrad,
+    ReduceGrads,
+    ReduceTiedGrads,
+    SendActivation,
+    SendGrad,
+    TrainSchedule,
+)
+
+
+@dataclass
+class ExecutionStats:
+    """Observable 1F1B invariants, per stage."""
+
+    peak_live_buffers: List[int]
+    optimizer_steps: int = 0
+    reduce_grads: int = 0
+    deadlock_retries: int = 0
+
+
+@dataclass
+class _StageState:
+    buffers: List[Optional[jnp.ndarray]]
+    saved_vjp: Dict[int, Callable] = field(default_factory=dict)
+    in_grad: Dict[int, jnp.ndarray] = field(default_factory=dict)
+    recv_grad: Dict[int, jnp.ndarray] = field(default_factory=dict)
+    fwd_count: int = 0
+    bwd_count: int = 0
+    peak_live: int = 0
+
+
+def interpret_schedule(
+    layer_params: Any,
+    x: jnp.ndarray,
+    layer_fn: Callable,
+    num_stages: int,
+    num_micro: int,
+    ybar: Optional[jnp.ndarray] = None,
+    schedule_cls: type = TrainSchedule,
+) -> Tuple[jnp.ndarray, Any, Optional[jnp.ndarray], ExecutionStats]:
+    """Execute a ``PipeSchedule`` over a stacked layer tree.
+
+    ``layer_params`` leaves have leading dim L (L % num_stages == 0);
+    ``layer_fn(h, one_layer_params) -> h`` applies one layer; ``x`` is
+    [B, ...] split into ``num_micro`` microbatches.  With ``ybar`` (the
+    output cotangent, [B, ...]) and a ``TrainSchedule``, the backward
+    instructions run too and the returned tree holds weight grads + input
+    cotangent; with ``InferenceSchedule`` both are None.
+
+    Returns ``(out, wgrad, xbar, stats)``.
+    """
+    L = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    if L % num_stages:
+        raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+    B = x.shape[0]
+    if B % num_micro:
+        raise ValueError(f"batch {B} not divisible by {num_micro} microbatches")
+    mb = B // num_micro
+    per = L // num_stages
+    xm = x.reshape((num_micro, mb) + x.shape[1:])
+    ybm = None
+    if ybar is not None:
+        ybm = ybar.reshape((num_micro, mb) + ybar.shape[1:])
+
+    def stage_slice(s):
+        return jax.tree_util.tree_map(
+            lambda w: w[s * per : (s + 1) * per], layer_params
+        )
+
+    def stage_fn(lw, h):
+        def one(carry, w):
+            return layer_fn(carry, w), None
+
+        h, _ = jax.lax.scan(one, h, lw)
+        return h
+
+    # train mode = the schedule emits BackwardPass instructions (probe one
+    # stage's stream) — class identity would misroute e.g.
+    # DataParallelSchedule, which backwards without being a TrainSchedule
+    train = any(
+        isinstance(c, BackwardPass)
+        for step in schedule_cls(num_micro, num_stages, num_stages - 1)
+        for c in step
+    )
+    if train and ybar is None:
+        raise ValueError(f"{schedule_cls.__name__} needs the output cotangent ybar")
+    schedules = [
+        schedule_cls(num_micro, num_stages, s) for s in range(num_stages)
+    ]
+    states = [
+        _StageState(buffers=[None] * sched.num_pipe_buffers())
+        for sched in schedules
+    ]
+    # mailboxes: act[s] carries stage s -> s+1, grad[s] carries s -> s-1
+    act_q: List[deque] = [deque() for _ in range(num_stages)]
+    grad_q: List[deque] = [deque() for _ in range(num_stages)]
+    outputs: List[Optional[jnp.ndarray]] = [None] * num_micro
+    xbar_rows: List[Optional[jnp.ndarray]] = [None] * num_micro
+    wgrads = [
+        jax.tree_util.tree_map(
+            lambda w: jnp.zeros_like(w, dtype=jnp.float32), stage_slice(s)
+        )
+        for s in range(num_stages)
+    ]
+    stats = ExecutionStats(peak_live_buffers=[0] * num_stages)
+
+    def execute(s: int, cmd) -> bool:
+        """Run one instruction for stage ``s``; False = blocked on a recv."""
+        st = states[s]
+        sched = schedules[s]
+        if isinstance(cmd, LoadMicroBatch):
+            st.buffers[cmd.buffer_id] = xm[st.fwd_count]
+        elif isinstance(cmd, RecvActivation):
+            if s == 0:
+                # negative indexing would silently pop the LAST stage's
+                # mailbox — a buggy schedule must deadlock/raise, not
+                # consume the wrong tensor
+                raise RuntimeError("RecvActivation on stage 0: bad schedule")
+            if not act_q[s - 1]:
+                return False
+            st.buffers[cmd.buffer_id] = act_q[s - 1].popleft()
+        elif isinstance(cmd, ForwardPass):
+            h = st.buffers[cmd.buffer_id]
+            m = st.fwd_count
+            if train:
+                out, vjp = jax.vjp(stage_fn, stage_slice(s), h)
+                st.saved_vjp[cmd.buffer_id] = vjp
+                st.peak_live = max(st.peak_live, len(st.saved_vjp))
+            else:
+                out = stage_fn(stage_slice(s), h)
+            st.buffers[cmd.buffer_id] = out
+            if sched.is_last_stage:
+                outputs[m] = out
+            st.fwd_count += 1
+        elif isinstance(cmd, SendActivation):
+            act_q[s].append(st.buffers[cmd.buffer_id])
+        elif isinstance(cmd, RecvGrad):
+            if not grad_q[s + 1]:
+                return False
+            st.recv_grad[cmd.buffer_id] = grad_q[s + 1].popleft()
+        elif isinstance(cmd, BackwardPass):
+            m = st.bwd_count
+            if sched.is_last_stage:
+                g = ybm[m]
+            else:
+                g = st.recv_grad.pop(cmd.buffer_id)
+            vjp = st.saved_vjp.pop(cmd.buffer_id)
+            wg, xg = vjp(g)
+            wgrads[s] = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), wgrads[s], wg
+            )
+            if sched.is_first_stage:
+                xbar_rows[m] = xg
+            else:
+                st.in_grad[cmd.buffer_id] = xg
+            st.bwd_count += 1
+        elif isinstance(cmd, SendGrad):
+            grad_q[s].append(st.in_grad.pop(cmd.buffer_id))
+        elif isinstance(cmd, ReduceGrads):
+            stats.reduce_grads += 1  # single-process: DP allreduce is a no-op
+        elif isinstance(cmd, ReduceTiedGrads):
+            pass  # tied weights share one array here; XLA sums contributions
+        elif isinstance(cmd, OptimizerStep):
+            stats.optimizer_steps += 1
+        else:
+            raise ValueError(f"unknown instruction {cmd!r}")
+        return True
+
+    iters = [iter(sched) for sched in schedules]
+    live = [True] * num_stages
+    while any(live):
+        # fetch this step's instruction list per stage
+        step_cmds: List[deque] = []
+        for s in range(num_stages):
+            if not live[s]:
+                step_cmds.append(deque())
+                continue
+            try:
+                step_cmds.append(deque(next(iters[s])))
+            except StopIteration:
+                live[s] = False
+                step_cmds.append(deque())
+        # cooperative round-robin within the step: a blocked recv yields to
+        # the other stages; no progress across a full sweep => deadlock
+        pending = sum(len(q) for q in step_cmds)
+        while pending:
+            progressed = False
+            for s in range(num_stages):
+                while step_cmds[s]:
+                    if not execute(s, step_cmds[s][0]):
+                        stats.deadlock_retries += 1
+                        break
+                    step_cmds[s].popleft()
+                    progressed = True
+            new_pending = sum(len(q) for q in step_cmds)
+            if not progressed and new_pending:
+                stuck = {
+                    s: list(step_cmds[s]) for s in range(num_stages)
+                    if step_cmds[s]
+                }
+                raise RuntimeError(f"schedule deadlock: {stuck}")
+            pending = new_pending
+    for s in range(num_stages):
+        stats.peak_live_buffers[s] = states[s].peak_live
+
+    out = jnp.concatenate([o for o in outputs], axis=0) if outputs[0] is not None else None
+    if not train or ybar is None:
+        return out, None, None, stats
+    wgrad = jax.tree_util.tree_map(
+        lambda *parts: jnp.concatenate(parts, axis=0), *wgrads
+    )
+    wgrad = jax.tree_util.tree_map(
+        lambda g, w: g.astype(w.dtype), wgrad, layer_params
+    )
+    xbar = jnp.concatenate(xbar_rows, axis=0)
+    return out, wgrad, xbar, stats
+
+
+def interpret_inference(
+    layer_params, x, layer_fn, num_stages, num_micro
+) -> Tuple[jnp.ndarray, ExecutionStats]:
+    """Forward-only execution under ``InferenceSchedule`` (fill-drain)."""
+    out, _, _, stats = interpret_schedule(
+        layer_params, x, layer_fn, num_stages, num_micro,
+        schedule_cls=InferenceSchedule,
+    )
+    return out, stats

@@ -20,9 +20,19 @@ inside a ``shard_map`` that is *manual only over the ``stage`` axis*:
   differentiated, so everything the region needs is spelled out.
 
 Tick t holds microbatch ``t - stage_id`` on each stage; total ticks
-``M + S - 1``; per-tick body is rematerialised (``jax.checkpoint``) so live
-activation memory is one microbatch per stage — the same memory contract as
-the reference's 1F1B with activation checkpointing.
+``M + S - 1``.
+
+Memory contract (the reference 1F1B's reason to exist, pipe/schedule.py:189):
+reverse-mode autodiff of the forward scan would store every tick's input —
+O(M) live microbatch activations per stage.  Instead the scan carries a
+``custom_vjp`` whose backward is a *wave + chase* scan: a forward recompute
+wave re-derives each stage's microbatch inputs from ``x_all`` (stage 0's
+injections are the only true inputs), and the backward chases it
+``2*(S-1-s)`` ticks behind through a bounded FIFO of ``2S-1`` slots,
+recomputing each stage's forward inside ``jax.vjp`` at the tick its
+cotangent arrives.  Live residuals per stage: the FIFO (O(S) microbatch
+activations) — independent of the microbatch count, the same bound as
+1F1B with activation checkpointing.
 """
 from __future__ import annotations
 
@@ -55,13 +65,13 @@ def pipeline_apply(
     Returns activations [B, ...] (plus the summed aux scalar when
     ``with_aux``) after all L layers.
 
-    Memory contract: the per-tick body is rematerialised, so each stage's
-    backward residuals are the T tick *inputs* ([mb, ...] block inputs, not
-    full per-layer activations) plus one [M, mb, ...] output buffer — the
-    fused-scan analogue of 1F1B-with-activation-checkpointing (the
-    reference's PipelineEngine + CheckpointFunction pairing).  There is no
-    per-tick emit stream: outputs accumulate in-place into the carry
-    (VERDICT r2 weak #3's [S*T, ...] gather is gone).
+    Memory contract: the backward is a hand-written wave+chase scan (see
+    module docstring) — each stage's live residuals are a ``2S-1``-slot
+    FIFO of [mb, ...] stage inputs, O(S) and independent of ``num_micro``,
+    matching 1F1B-with-activation-checkpointing (the reference's
+    PipelineEngine + CheckpointFunction pairing).  Outputs accumulate
+    in-place into the carry (no [S*T, ...] emit stream), and the forward
+    saves nothing per tick (the backward recomputes from ``x``).
     """
     mesh = mesh if mesh is not None else get_current_mesh()
     if mesh is None:
@@ -88,49 +98,55 @@ def pipeline_apply(
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     dp_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS, SUB_AXIS) if sizes.get(a, 1) > 1)
 
-    def stage_body(local_layers, x_all):
+    S = num_stages
+    M = num_micro
+    p_dp = 1
+    for a in dp_axes:
+        p_dp *= sizes[a]
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_rev = [(i, (i - 1) % S) for i in range(S)]
+
+    def apply_stage(local_layers, h):
+        def one(carry, lw):
+            h, aux = carry
+            # no explicit sharding constraints inside the manual region
+            # (they crash XLA's backward partitioner); GSPMD still
+            # propagates TP layouts from the weights
+            with mesh_disabled():
+                out = layer_fn(h, lw)
+            if with_aux:
+                h, a = out
+                aux = aux + a
+            else:
+                h = out
+            return (h, aux), None
+
+        (h, aux), _ = lax.scan(
+            one, (h, jnp.asarray(0.0, jnp.float32)), local_layers
+        )
+        return h, aux
+
+    def fwd_body(local_layers, x_all):
         sid = lax.axis_index(STAGE_AXIS)
         is_first = sid == 0
-        is_last = sid == num_stages - 1
-        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        is_last = sid == S - 1
 
-        def apply_stage(h):
-            def one(carry, lw):
-                h, aux = carry
-                # no explicit sharding constraints inside the manual region
-                # (they crash XLA's backward partitioner); GSPMD still
-                # propagates TP layouts from the weights
-                with mesh_disabled():
-                    out = layer_fn(h, lw)
-                if with_aux:
-                    h, a = out
-                    aux = aux + a
-                else:
-                    h = out
-                return (h, aux), None
-
-            (h, aux), _ = lax.scan(
-                one, (h, jnp.asarray(0.0, jnp.float32)), local_layers
-            )
-            return h, aux
-
-        @functools.partial(jax.checkpoint, prevent_cse=False)
         def tick(carry, t):
             buf, out_buf, aux_acc = carry
             inject = lax.dynamic_index_in_dim(
-                x_all, jnp.clip(t, 0, num_micro - 1), axis=0, keepdims=False
+                x_all, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
             )
-            take = jnp.logical_and(is_first, t < num_micro)
+            take = jnp.logical_and(is_first, t < M)
             buf = jnp.where(take, inject, buf)
-            buf, aux = apply_stage(buf)
+            buf, aux = apply_stage(local_layers, buf)
             # stage s holds microbatch t - s at tick t; outside [0, M) the
             # buffer is bubble garbage — gate aux on validity
             micro_here = t - sid
-            valid = jnp.logical_and(micro_here >= 0, micro_here < num_micro)
+            valid = jnp.logical_and(micro_here >= 0, micro_here < M)
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             # the last stage accumulates finished microbatches in place —
             # no [T, ...] emit stream, no cross-stage stacking
-            write_slot = jnp.clip(micro_here, 0, num_micro - 1)
+            write_slot = jnp.clip(micro_here, 0, M - 1)
             write = jnp.logical_and(is_last, valid)
             out_buf = lax.dynamic_update_index_in_dim(
                 out_buf,
@@ -139,7 +155,7 @@ def pipeline_apply(
                 write_slot,
                 axis=0,
             )
-            buf = lax.ppermute(buf, STAGE_AXIS, perm)
+            buf = lax.ppermute(buf, STAGE_AXIS, perm_fwd)
             return (buf, out_buf, aux_acc), None
 
         buf0 = jnp.zeros_like(x_all[0])
@@ -149,7 +165,7 @@ def pipeline_apply(
         )
         # broadcast the last stage's buffer to every stage (one [B, ...]
         # collective — replaces the old S*T-row stacked emit gather)
-        last_mask = (sid == num_stages - 1).astype(out_buf.dtype)
+        last_mask = (sid == S - 1).astype(out_buf.dtype)
         out_buf = lax.psum(out_buf * last_mask, STAGE_AXIS)
         # aux contract: per-layer scalars are MEANS over this DP shard's
         # rows (MoE gating aux is token-mean) — sum across stages (each
@@ -157,10 +173,86 @@ def pipeline_apply(
         # microbatches (the dense path computes each layer's mean once over
         # the whole batch; summing per-microbatch means would scale the
         # regularizer by num_micro)
-        aux_total = lax.psum(aux_acc, STAGE_AXIS) / num_micro
+        aux_total = lax.psum(aux_acc, STAGE_AXIS) / M
         for ax in dp_axes:
             aux_total = lax.pmean(aux_total, ax)
         return out_buf, aux_total
+
+    # Backward: wave + chase.  The recompute wave replays the forward
+    # schedule (stage s sees microbatch m's input at tick m+s, saved into a
+    # (2S-1)-slot FIFO); the chase runs microbatch m's VJP at stage s at
+    # tick m + 2(S-1) - s, when its cotangent arrives from stage s+1 over
+    # the reverse ring.  Hold time in the FIFO is 2(S-1-s) ticks <= 2S-2,
+    # so live residuals are O(S) microbatch inputs regardless of M.
+    K = max(1, 2 * S - 1)
+    U = M + 2 * (S - 1)
+
+    def bwd_body(local_layers, x_all, ybar, auxbar):
+        sid = lax.axis_index(STAGE_AXIS)
+        is_first = sid == 0
+        is_last = sid == S - 1
+        # d(aux_total)/d(per-tick aux) — psum over stages is identity for
+        # each stage's own contribution; /M and the DP pmean divide through
+        aux_ct = auxbar / (M * p_dp)
+
+        def tick(carry, u):
+            buf, fifo, gbuf, wgrad = carry
+            # ---- recompute wave (identical to the forward tick) ----
+            inject = lax.dynamic_index_in_dim(
+                x_all, jnp.clip(u, 0, M - 1), axis=0, keepdims=False
+            )
+            take = jnp.logical_and(is_first, u < M)
+            buf = jnp.where(take, inject, buf)
+            fifo = lax.dynamic_update_index_in_dim(fifo, buf, u % K, axis=0)
+            fout, _ = apply_stage(local_layers, buf)
+            # ---- backward chase ----
+            m_b = u - 2 * (S - 1) + sid
+            valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+            slot = (u - 2 * (S - 1 - sid)) % K  # == (m_b + sid) % K
+            x_in = lax.dynamic_index_in_dim(fifo, slot, axis=0, keepdims=False)
+            yrow = lax.dynamic_index_in_dim(
+                ybar, jnp.clip(m_b, 0, M - 1), axis=0, keepdims=False
+            )
+            yb = jnp.where(is_last, yrow, gbuf)
+            _, vjp_fn = jax.vjp(apply_stage, local_layers, x_in)
+            lw_bar, x_bar = vjp_fn(
+                (yb, jnp.where(valid_b, aux_ct, jnp.zeros_like(aux_ct)))
+            )
+            wgrad = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(valid_b, g, 0).astype(acc.dtype),
+                wgrad, lw_bar,
+            )
+            buf = lax.ppermute(fout, STAGE_AXIS, perm_fwd)
+            gbuf = lax.ppermute(x_bar, STAGE_AXIS, perm_rev)
+            # stage 0's input cotangent IS d/d(x_all[m_b]); emit as a scan
+            # output (not carry) so it never sits in the loop state
+            xrow = jnp.where(
+                jnp.logical_and(is_first, valid_b), x_bar, jnp.zeros_like(x_bar)
+            )
+            return (buf, fifo, gbuf, wgrad), xrow
+
+        buf0 = jnp.zeros_like(x_all[0])
+        fifo0 = jnp.zeros((K,) + x_all.shape[1:], x_all.dtype)
+        gbuf0 = jnp.zeros_like(x_all[0])
+        wgrad0 = jax.tree_util.tree_map(
+            lambda w: jnp.zeros(w.shape, jnp.float32), local_layers
+        )
+        (_, _, _, wgrad), xrows = lax.scan(
+            tick, (buf0, fifo0, gbuf0, wgrad0), jnp.arange(U)
+        )
+        # stage s emitted m's xrow at tick m + 2(S-1): slice the M valid rows
+        xbar = lax.dynamic_slice_in_dim(xrows, 2 * (S - 1), M, axis=0)
+        # only stage 0 wrote real rows — broadcast across the stage ring
+        xbar = lax.psum(xbar, STAGE_AXIS)
+        # DP shards processed disjoint microbatch rows: weight grads sum
+        for ax in dp_axes:
+            wgrad = jax.tree_util.tree_map(
+                functools.partial(lax.psum, axis_name=ax), wgrad
+            )
+        wgrad = jax.tree_util.tree_map(
+            lambda g, w: g.astype(w.dtype), wgrad, local_layers
+        )
+        return wgrad, xbar
 
     # microbatch rows shard over the DP axes; everything else replicated
     batch_entry = filter_spec((mb,), P((DATA_AXIS, FSDP_AXIS, SUB_AXIS)), mesh)[0]
@@ -169,14 +261,38 @@ def pipeline_apply(
     layer_specs = jax.tree_util.tree_map(
         lambda leaf: P(*((STAGE_AXIS,) + (None,) * (leaf.ndim - 1))), layer_params
     )
-    fn = jax.shard_map(
-        stage_body,
+
+    fwd_sm = jax.shard_map(
+        fwd_body,
         mesh=mesh,
         in_specs=(layer_specs, x_spec),
         out_specs=out_spec,
         check_vma=False,
     )
-    out, aux = fn(layer_params, xm)  # [M, mb, ...], scalar
+    bwd_sm = jax.shard_map(
+        bwd_body,
+        mesh=mesh,
+        in_specs=(layer_specs, x_spec, x_spec, P()),
+        out_specs=(layer_specs, x_spec),
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def run(layer_params, xm):
+        return fwd_sm(layer_params, xm)
+
+    def run_fwd(layer_params, xm):
+        return fwd_sm(layer_params, xm), (layer_params, xm)
+
+    def run_bwd(res, cts):
+        layer_params, xm = res
+        ybar, auxbar = cts
+        wgrad, xbar = bwd_sm(layer_params, xm, ybar, jnp.asarray(auxbar))
+        return wgrad, xbar
+
+    run.defvjp(run_fwd, run_bwd)
+
+    out, aux = run(layer_params, xm)  # [M, mb, ...], scalar
     out = out.reshape((B,) + x.shape[1:])
     if with_aux:
         return out, aux

