@@ -96,7 +96,18 @@ def pipeline_apply(
     from ...parallel.sharding import filter_spec
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    dp_axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS, SUB_AXIS) if sizes.get(a, 1) > 1)
+    # microbatch rows shard over the DP axes — but only when mb actually
+    # divides them (filter_spec falls back to replication otherwise).  The
+    # hand-written backward psums weight grads over dp_axes, so dp_axes MUST
+    # be derived from the spec actually applied to the batch: psum-ing over
+    # an axis the batch is replicated on would multiply grads by its size.
+    batch_entry = filter_spec((mb,), P((DATA_AXIS, FSDP_AXIS, SUB_AXIS)), mesh)[0]
+    if batch_entry is None:
+        dp_axes = ()
+    elif isinstance(batch_entry, tuple):
+        dp_axes = tuple(a for a in batch_entry if sizes.get(a, 1) > 1)
+    else:
+        dp_axes = (batch_entry,) if sizes.get(batch_entry, 1) > 1 else ()
 
     S = num_stages
     M = num_micro
@@ -254,8 +265,6 @@ def pipeline_apply(
         )
         return wgrad, xbar
 
-    # microbatch rows shard over the DP axes; everything else replicated
-    batch_entry = filter_spec((mb,), P((DATA_AXIS, FSDP_AXIS, SUB_AXIS)), mesh)[0]
     x_spec = P(*((None, batch_entry) + (None,) * (x.ndim - 1)))
     out_spec = (P(*((None, batch_entry) + (None,) * (x.ndim - 1))), P())
     layer_specs = jax.tree_util.tree_map(

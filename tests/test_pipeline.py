@@ -480,3 +480,37 @@ def test_interpreter_matches_fused_executor(stage_mesh):
                                atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(gx_fused), np.asarray(gx_i),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_grads_correct_when_batch_replicated():
+    """r4 review: when mb doesn't divide the DP axes, filter_spec replicates
+    the batch — the hand-written backward must NOT psum weight grads over
+    axes the batch isn't actually sharded on (was: grads x data-axis-size)."""
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+
+    grid = initialize_mesh(stage=2, data=4)
+    set_current_mesh(grid.mesh)
+    try:
+        rng = np.random.default_rng(7)
+        L, B, d = 2, 3, 8  # B=3 does not divide data=4 -> replicated
+        w = jnp.asarray(rng.normal(size=(L, d, d)) * 0.2, jnp.float32)
+        x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+        def layer_fn(h, lw):
+            return jnp.tanh(h @ lw)
+
+        def loss_pipe(w):
+            return jnp.sum(pipeline_apply(w, x, layer_fn, 2, 1) ** 2)
+
+        def loss_seq(w):
+            h = x
+            for i in range(L):
+                h = layer_fn(h, w[i])
+            return jnp.sum(h ** 2)
+
+        gp = jax.jit(jax.grad(loss_pipe))(w)
+        gs = jax.grad(loss_seq)(w)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   atol=1e-4, rtol=1e-4)
+    finally:
+        set_current_mesh(None)
