@@ -126,6 +126,35 @@ def initialize(
         model.cfg = model.cfg.replace(act_quant_bits=bits)
         log_dist(f"activation quantization: {bits}-bit STE on sublayer inputs")
 
+    if cfg.sparse_attention.mode:
+        # block-sparse attention layouts are a model-forward construct (the
+        # reference swaps attention modules via SparseAttentionUtils) — the
+        # config key must change behavior, never be silently dropped
+        if model is None or not hasattr(model, "cfg"):
+            raise ConfigError(
+                "sparse_attention requires model= (a models.CausalLM); it "
+                "cannot be injected into a raw loss_fn"
+            )
+        if getattr(model.cfg, "sequence_parallel", "none") == "ring":
+            raise ConfigError(
+                "sparse_attention composes with ulysses but not ring "
+                "(ring attention supplies its own attention body)"
+            )
+        sp = cfg.sparse_attention.build()
+        model.cfg = model.cfg.replace(sparse_attention=sp)
+        log_dist(
+            f"sparse attention: mode={cfg.sparse_attention.mode} "
+            f"block={sp.block}"
+        )
+
+    if cfg.progressive_layer_drop.enabled and (
+        model is None or not hasattr(model, "cfg")
+    ):
+        raise ConfigError(
+            "progressive_layer_drop requires model= (a models.CausalLM) so "
+            "the engine can thread the per-step layer-keep mask"
+        )
+
     if model is not None and loss_fn is None:
         loss_fn = model.loss_fn
         if tp_rules is None:
@@ -251,4 +280,11 @@ def initialize(
         engine.training_dataloader = dataloader  # sampler state rides checkpoints
     if lr_scheduler is not None:
         log_dist("external lr_scheduler object ignored; use config['scheduler']")
+    if cfg.hybrid_engine.enabled:
+        # reference deepspeed/__init__.py:131: hybrid_engine.enabled swaps
+        # the returned engine for the RLHF train<->generate wrapper
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(engine)
+        log_dist("hybrid engine enabled: generate() serves the live weights")
     return engine, engine, dataloader, engine.lr_scheduler

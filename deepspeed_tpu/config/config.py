@@ -241,6 +241,179 @@ class DataEfficiencyConfig:
 
 
 @dataclass
+class PLDConfig:
+    """reference: runtime/config.py progressive_layer_drop + PLD post."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@dataclass
+class EigenvalueConfig:
+    """reference: runtime/config.py eigenvalue_* (engine.py:1503 hook)."""
+
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = ""
+    layer_num: int = 0
+
+    def __post_init__(self):
+        if self.gas_boundary_resolution < 1:
+            raise ConfigError(
+                f"eigenvalue.gas_boundary_resolution must be >= 1, got "
+                f"{self.gas_boundary_resolution}"
+            )
+        if self.max_iter < 1:
+            raise ConfigError(f"eigenvalue.max_iter must be >= 1, got {self.max_iter}")
+
+
+@dataclass
+class SparseAttentionConfig:
+    """reference: ops/sparse_attention/sparsity_config.py schemas; mode ''
+    (absent key) = disabled.  Only keys relevant to the implemented layouts
+    are accepted — the point is config-drives-behavior, not schema cosplay."""
+
+    mode: str = ""
+    block: int = 16
+    different_layout_per_head: bool = False
+    # fixed
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    # bigbird
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    # bsLongformer
+    global_block_indices: List[int] = field(default_factory=lambda: [0])
+    # variable
+    local_window_blocks: List[int] = field(default_factory=lambda: [4])
+
+    def __post_init__(self):
+        if self.mode not in ("", "dense", "fixed", "bigbird", "bsLongformer",
+                             "variable"):
+            raise ConfigError(
+                f"sparse_attention.mode '{self.mode}' not in "
+                "dense|fixed|bigbird|bsLongformer|variable"
+            )
+        if self.different_layout_per_head:
+            raise ConfigError(
+                "sparse_attention.different_layout_per_head is not supported: "
+                "all heads share one block layout here"
+            )
+        if self.block < 1:
+            raise ConfigError(f"sparse_attention.block must be >= 1, got {self.block}")
+        if any(w < 1 for w in self.local_window_blocks):
+            raise ConfigError(
+                f"sparse_attention.local_window_blocks must be positive, got "
+                f"{self.local_window_blocks}"
+            )
+
+    def build(self):
+        """Instantiate the ops-level SparsityConfig for this mode."""
+        from ..ops.sparse_attention import (
+            BigBirdSparsityConfig,
+            BSLongformerSparsityConfig,
+            DenseSparsityConfig,
+            FixedSparsityConfig,
+            VariableSparsityConfig,
+        )
+
+        if self.mode in ("", "dense"):
+            return DenseSparsityConfig(block=self.block)
+        if self.mode == "fixed":
+            return FixedSparsityConfig(
+                block=self.block,
+                num_local_blocks=self.num_local_blocks,
+                num_global_blocks=self.num_global_blocks,
+            )
+        if self.mode == "bigbird":
+            return BigBirdSparsityConfig(
+                block=self.block,
+                num_random_blocks=self.num_random_blocks,
+                num_sliding_window_blocks=self.num_sliding_window_blocks,
+                num_global_blocks=self.num_global_blocks,
+            )
+        if self.mode == "bsLongformer":
+            return BSLongformerSparsityConfig(
+                block=self.block,
+                num_sliding_window_blocks=self.num_sliding_window_blocks,
+                global_block_indices=tuple(self.global_block_indices),
+            )
+        return VariableSparsityConfig(
+            block=self.block,
+            local_window_blocks=tuple(self.local_window_blocks),
+            num_global_blocks=self.num_global_blocks,
+        )
+
+
+@dataclass
+class CompileConfig:
+    """reference: runtime/compiler.py CompileConfig (torch.compile knobs).
+
+    On TPU, jit IS the substrate — ``enabled`` is accepted (always true in
+    effect) and ``disable: true`` switches the engine's train/eval steps to
+    eager per-op execution for debugging (the torch.compile-disable
+    analogue).  ``backend``/``kwargs`` are validated but vestigial."""
+
+    enabled: bool = True
+    disable: bool = False
+    backend: str = "xla"
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class HybridEngineConfig:
+    """reference: runtime/config.py hybrid_engine (DeepSpeedHybridEngine).
+
+    ``max_out_tokens`` caps generate() lengths.  ``inference_tp_size`` must
+    stay 1: hybrid serving follows the training mesh (set mesh.model for TP).
+    ``release_inference_cache``/``pin_parameters``/``tp_gather_partition_size``
+    are GPU container-flipping knobs with no counterpart (the serving jits
+    take live params as arguments; there is nothing to pin or flip) —
+    accepted for reference-config compat only."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@dataclass
+class AIOConfig:
+    """reference: runtime/swap_tensor/aio_config.py — thread_count and
+    queue_depth reach the C++ AIO engine (csrc/aio) behind NVMe offload/
+    swap.  block_size / single_submit / overlap_events are libaio
+    submission-strategy knobs with no counterpart in the thread-pool design
+    (whole-tensor files, always-overlapped completion thread) — accepted for
+    reference-config compat only."""
+
+    block_size: int = 1 << 20
+    queue_depth: int = 32
+    thread_count: int = 8
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclass
+class NebulaConfig:
+    """reference: nebula/config.py — an async checkpoint service.  Mapped to
+    the async checkpoint engine (checkpoint/engine.py): enabled => async_save."""
+
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: int = 100
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+    load_path: Optional[str] = None
+
+
+@dataclass
 class Config:
     """Top-level validated config (reference: DeepSpeedConfig)."""
 
@@ -275,6 +448,13 @@ class Config:
     csv_monitor: MonitorSubConfig = field(default_factory=MonitorSubConfig)
     wandb: MonitorSubConfig = field(default_factory=MonitorSubConfig)
     elasticity: Dict[str, Any] = field(default_factory=dict)
+    progressive_layer_drop: PLDConfig = field(default_factory=PLDConfig)
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+    sparse_attention: SparseAttentionConfig = field(default_factory=SparseAttentionConfig)
+    compile: CompileConfig = field(default_factory=CompileConfig)
+    hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
+    aio: AIOConfig = field(default_factory=AIOConfig)
+    nebula: NebulaConfig = field(default_factory=NebulaConfig)
 
     # --- derived (filled by finalize) ---
     dp_world_size: int = 1
@@ -338,26 +518,43 @@ class Config:
         return self
 
 
+# Keys a DeepSpeed JSON may contain that are accepted and DELIBERATELY
+# ignored — each entry must be genuinely n/a on this stack, with the reason
+# recorded here.  Features that exist in this repo must NOT hide in this set
+# (the "accepted-and-ignored is worse than absent" rule): their keys are real
+# Config fields consumed by initialize()/the engine.
 _REFERENCE_PASSTHROUGH_KEYS = {
-    # keys a DeepSpeed JSON may contain that we accept and ignore
+    # permission flag for unvalidated optimizers under ZeRO — this engine
+    # treats every optax optimizer as first-class, so there is nothing to gate
     "zero_allow_untested_optimizer",
+    # forces DeepSpeedCPUAdam over torch Adam for CPU offload — there is one
+    # host Adam (csrc/adam), no alternative to force
     "zero_force_ds_cpu_optimizer",
+    # wire dtype for NCCL collectives — GSPMD inserts collectives in the
+    # array dtype; quantized wire formats are the zero++ knobs
+    # (zero_quantized_weights/gradients), which ARE consumed
     "communication_data_type",
+    # torch sparse embedding gradients — XLA has no sparse gradient type;
+    # embedding grads are dense psums (SURVEY: documented won't-do)
     "sparse_gradients",
+    # NVIDIA apex mixed precision — bf16/fp16 configs are the path here
     "amp",
+    # consumed by the offline autotuner entrypoint (autotuning/autotuner.py),
+    # never by the runtime engine — same split as the reference's ds_autotuner
     "autotuning",
-    "aio",
-    "curriculum_learning",
-    "pipeline",
+    # monitor backend whose SDK is not in this image; tensorboard/csv/wandb
+    # backends exist (monitor/monitor.py)
     "comet",
-    "hybrid_engine",
-    "compile",
-    "sparse_attention",
-    "progressive_layer_drop",
-    "eigenvalue",
-    "nebula",
-    "checkpoint_engine",
+    # pipeline-engine knobs (partition method, activation checkpoint
+    # interval) — stage count and partitioning are constructor arguments of
+    # PipelinedCausalLM/PipelineModule, chosen with the model, not the JSON
+    "pipeline",
+    # ZeRO-Inference post-training weight quantization schema — covered by
+    # compression_training.weight_quantization (QAT) and ops/quantizer.py
     "weight_quantization",
+    # pluggable checkpoint engine class selection — selection here is
+    # checkpoint.async_save / nebula.enabled (checkpoint/engine.py)
+    "checkpoint_engine",
 }
 
 
@@ -385,8 +582,22 @@ def parse_config(source: Any, dp_world_size: Optional[int] = None) -> Config:
     for k in list(raw.keys()):
         if k in _REFERENCE_PASSTHROUGH_KEYS:
             raw.pop(k)
+    # legacy top-level curriculum (reference runtime/config.py
+    # curriculum_learning_legacy) maps onto the data_efficiency section
+    if "curriculum_learning" in raw:
+        legacy = raw.pop("curriculum_learning")
+        if "data_efficiency" not in raw:
+            raw["data_efficiency"] = {
+                "enabled": bool(legacy.get("enabled", False)),
+                "curriculum_learning": legacy,
+            }
+        # else: the modern section wins (the reference also prefers
+        # data_efficiency when both are present)
     raw = _strip_auto(raw)
     cfg = _coerce(Config, raw)
+    if cfg.nebula.enabled:
+        # nebula IS an async checkpoint service; same engine here
+        cfg.checkpoint.async_save = True
     if dp_world_size is not None:
         cfg.finalize(dp_world_size)
     return cfg

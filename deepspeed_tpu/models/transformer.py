@@ -80,6 +80,9 @@ class TransformerConfig:
     # reference's schedule_offset-gated module hooks, quantization is active
     # from step 0 — the loss_fn contract carries no step.
     act_quant_bits: Optional[int] = None
+    # block-sparse attention layout (ops/sparse_attention.SparsityConfig);
+    # wired from the config's sparse_attention section by initialize()
+    sparse_attention: Optional[Any] = None
 
     @property
     def hd(self) -> int:
@@ -331,7 +334,14 @@ def decoder_layer(
 def _get_attn_fn(cfg: TransformerConfig) -> Callable:
     from ..ops.attention import get_attention_impl
 
-    base = get_attention_impl(cfg.attn_impl)
+    if cfg.sparse_attention is not None:
+        import functools as _ft
+
+        from ..ops.sparse_attention import block_sparse_attention
+
+        base = _ft.partial(block_sparse_attention, config=cfg.sparse_attention)
+    else:
+        base = get_attention_impl(cfg.attn_impl)
     if cfg.sequence_parallel == "ulysses":
         from ..sequence.layer import DistributedAttention
 
@@ -508,6 +518,18 @@ class CausalLM:
     def loss_fn(self, params, batch, rng=None):
         tokens = batch["input_ids"]
         segment_ids = batch.get("segment_ids")
+        # progressive layer drop: the engine injects a traced per-step theta
+        # under this key (runtime/engine.py PLD wiring; reference
+        # engine.py:1959 progressive_layer_drop.update_state)
+        pld_theta = batch.get("pld_theta") if hasattr(batch, "get") else None
+        layer_keep = None
+        if pld_theta is not None:
+            from ..runtime.progressive_layer_drop import layer_keep_mask
+
+            krng = rng if rng is not None else jax.random.PRNGKey(0)
+            layer_keep = layer_keep_mask(
+                jax.random.fold_in(krng, 0x91D), self.cfg.num_layers, pld_theta
+            )
         if "labels" in batch:
             inputs, labels = tokens, batch["labels"]
         else:
@@ -520,6 +542,7 @@ class CausalLM:
             hidden, _, aux = forward(
                 params, inputs, self.cfg, segment_ids=segment_ids,
                 return_hidden=True, stack_apply=self.stack_apply,
+                layer_keep=layer_keep,
             )
             loss = chunked_cross_entropy(
                 hidden, head_kernel(params, self.cfg), labels,
@@ -528,7 +551,7 @@ class CausalLM:
         else:
             logits, _, aux = forward(
                 params, inputs, self.cfg, segment_ids=segment_ids,
-                stack_apply=self.stack_apply,
+                stack_apply=self.stack_apply, layer_keep=layer_keep,
             )
             loss = cross_entropy_loss(logits, labels)
         if self.cfg.moe_num_experts > 0:

@@ -29,10 +29,10 @@ class _Record:
 class TensorSwapper:
     """Named-tensor swap pool over a directory of files."""
 
-    def __init__(self, swap_dir: str, num_threads: int = 8):
+    def __init__(self, swap_dir: str, num_threads: int = 8, queue_depth: int = 32):
         self.dir = swap_dir
         os.makedirs(swap_dir, exist_ok=True)
-        self.engine = AsyncIOEngine(num_threads=num_threads)
+        self.engine = AsyncIOEngine(num_threads=num_threads, queue_depth=queue_depth)
         self._records: Dict[str, _Record] = {}
 
     def swap_out(self, name: str, array, blocking: bool = False) -> None:

@@ -121,14 +121,41 @@ class BSLongformerSparsityConfig(SparsityConfig):
         return layout
 
 
+@dataclass
+class VariableSparsityConfig(SparsityConfig):
+    """consecutive local windows of varying size + designated global blocks
+    (reference VariableSparsityConfig: local_window_blocks,
+    global_block_indices; the last window size repeats)."""
+
+    local_window_blocks: tuple = (4,)
+    num_global_blocks: int = 1
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        n = self._n(seq_len)
+        layout = np.zeros((n, n), bool)
+        i = 0
+        widx = 0
+        while i < n:
+            w = self.local_window_blocks[min(widx, len(self.local_window_blocks) - 1)]
+            layout[i : i + w, i : i + w] = True
+            i += w
+            widx += 1
+        g = min(self.num_global_blocks, n)
+        layout[:g, :] = True
+        layout[:, :g] = True
+        return layout
+
+
 def block_sparse_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     config: SparsityConfig,
     causal: bool = True,
+    q_offset=0,
     scale: Optional[float] = None,
     segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
 ):
     """[b, s, h, d] attention restricted to the config's block layout.
@@ -139,13 +166,24 @@ def block_sparse_attention(
     block layout controls semantics, not cost; for actual long-sequence
     memory savings use the flash kernel (causal) or ring attention.  A
     block-skipping Pallas variant is the open item.
+
+    Decode steps (``sq != sk``, cached KV) fall back to dense attention —
+    sparse layouts are a training/prefill construct (the reference's
+    SparseAttentionUtils also only patch the training forward).
     """
     from .attention import dot_product_attention
 
     s = q.shape[1]
+    if s != k.shape[1] or not (isinstance(q_offset, int) and q_offset == 0):
+        return dot_product_attention(
+            q, k, v, causal=causal, q_offset=q_offset, scale=scale,
+            segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
+            logits_soft_cap=logits_soft_cap,
+        )
     layout = jnp.asarray(config.make_layout(s))
     elem = jnp.repeat(jnp.repeat(layout, config.block, 0), config.block, 1)
     return dot_product_attention(
         q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
-        logits_soft_cap=logits_soft_cap, attn_mask=elem,
+        kv_segment_ids=kv_segment_ids, logits_soft_cap=logits_soft_cap,
+        attn_mask=elem,
     )

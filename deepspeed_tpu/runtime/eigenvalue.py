@@ -40,6 +40,10 @@ class Eigenvalue:
         self.gas_boundary_resolution = gas_boundary_resolution
         self.layer_name = layer_name
         self.layer_num = layer_num
+        # compiled HVP cache: params/batch/v are jit ARGUMENTS (closing over
+        # them would bake weights in as constants and recompile per call)
+        self._hvp_jit = None
+        self._hvp_key = None
 
     def _normalize(self, v):
         norm = jnp.sqrt(sum(jnp.vdot(x, x).real for x in jax.tree_util.tree_leaves(v)))
@@ -55,23 +59,26 @@ class Eigenvalue:
     ) -> Tuple[float, Any]:
         """Returns (eigenvalue, eigenvector-pytree) of d2L/dp2 at ``params``."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        grad_fn = jax.grad(lambda p: loss_fn(p, batch, None))
+        if self._hvp_key is not loss_fn:
+            def hvp(params, batch, v):
+                grad_fn = jax.grad(lambda p: loss_fn(p, batch, None))
+                return jax.jvp(grad_fn, (params,), (v,))[1]
 
-        def hvp(v):
-            return jax.jvp(grad_fn, (params,), (v,))[1]
-
-        hvp_jit = jax.jit(hvp)
+            self._hvp_jit = jax.jit(hvp)
+            self._hvp_key = loss_fn
         keys = jax.random.split(rng, len(jax.tree_util.tree_leaves(params)))
         flat, treedef = jax.tree_util.tree_flatten(params)
+        # tangents must match the primal dtype (bf16 compute copies under
+        # NVMe offload would otherwise make jax.jvp raise)
         v = jax.tree_util.tree_unflatten(
             treedef,
-            [jax.random.normal(k, x.shape, jnp.float32) for k, x in zip(keys, flat)],
+            [jax.random.normal(k, x.shape, x.dtype) for k, x in zip(keys, flat)],
         )
         v, _ = self._normalize(v)
         eig_prev = jnp.asarray(0.0, jnp.float32)
         eig = eig_prev
         for i in range(self.max_iter):
-            hv = hvp_jit(v)
+            hv = self._hvp_jit(params, batch, v)
             v, eig = self._normalize(hv)
             if self.verbose:
                 log_dist(f"eigenvalue iter {i}: {float(eig):.5f}")

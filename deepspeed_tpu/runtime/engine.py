@@ -294,6 +294,39 @@ class DeepSpeedTpuEngine:
                     f"compression: wq={manager.weight_quant.enabled} "
                     f"prune={manager.pruning.enabled}"
                 )
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            if self._zeropp_vag is not None or self._onebit:
+                from ..config.config import ConfigError
+
+                raise ConfigError(
+                    "progressive_layer_drop is not supported with 1-bit "
+                    "optimizers or ZeRO++ quantized collectives (their fused "
+                    "steps bypass the per-step theta injection)"
+                )
+            p = config.progressive_layer_drop
+            self.progressive_layer_drop = ProgressiveLayerDrop(p.theta, p.gamma)
+            log_dist(
+                f"progressive layer drop enabled: theta={p.theta} gamma={p.gamma}"
+            )
+        self.eigenvalue = None
+        self.block_eigenvalues: list = []
+        if config.eigenvalue.enabled:
+            from .eigenvalue import Eigenvalue
+
+            e = config.eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=e.verbose, max_iter=e.max_iter, tol=e.tol,
+                stability=e.stability,
+                gas_boundary_resolution=e.gas_boundary_resolution,
+                layer_name=e.layer_name, layer_num=e.layer_num,
+            )
+            log_dist(
+                f"eigenvalue estimation enabled: max_iter={e.max_iter} "
+                f"resolution={e.gas_boundary_resolution}"
+            )
         self.curriculum_scheduler = None
         cl = (config.data_efficiency.curriculum_learning or {})
         if config.data_efficiency.enabled and cl.get("enabled"):
@@ -321,6 +354,15 @@ class DeepSpeedTpuEngine:
             base = float(self.config.optimizer.params["lr"])
             return lambda step: jnp.asarray(base, jnp.float32)
         return get_lr_schedule_fn(sched.type, sched.params)
+
+    def _jit(self, fn, **kw):
+        """jax.jit unless ``compile.disable`` (the torch.compile-disable
+        analogue, reference runtime/compiler.py): eager per-op execution for
+        debugging.  Sharding/donation hints are compile-time concepts and are
+        skipped; static args are passed through as plain values."""
+        if self.config.compile.disable:
+            return fn
+        return jax.jit(fn, **kw)
 
     def batch_sharding(self, batch, batch_dim: int = 0):
         """Shard the batch dim of every leaf over the DP axes.  The fused
@@ -353,7 +395,18 @@ class DeepSpeedTpuEngine:
                 # QAT fake-quant / pruning via STE inside the traced step
                 # (compression/compress.py; reference init_compression)
                 cp = self._compression.transform(cp, step)
-            loss = self.loss_fn(cp, micro_batch, rng)
+            batch_ = micro_batch
+            if (
+                self.progressive_layer_drop is not None
+                and step is not None
+                and hasattr(batch_, "get")
+            ):
+                # traced per-step keep probability; the model draws the
+                # layer mask from it (CausalLM.loss_fn; reference
+                # engine.py:1959 pld theta update)
+                batch_ = dict(batch_)
+                batch_["pld_theta"] = self.progressive_layer_drop.theta_at(step)
+            loss = self.loss_fn(cp, batch_, rng)
             return loss * scale
 
         loss, grads = jax.value_and_grad(scaled_loss)(master_params)
@@ -471,7 +524,7 @@ class DeepSpeedTpuEngine:
             metrics_shardings = StepMetrics(
                 *([self._scalar_sharding] * len(StepMetrics._fields))
             )
-            jitted = jax.jit(
+            jitted = self._jit(
                 step_fn,
                 in_shardings=(self.state_shardings, self.batch_sharding(batch, batch_dim=1), None),
                 out_shardings=(self.state_shardings, metrics_shardings),
@@ -495,7 +548,7 @@ class DeepSpeedTpuEngine:
         that reject host-memory shardings inside jit (the CPU test mesh) fall
         back to staging the transfers around a device-kind step."""
         state_sh_dev = self._dev_state_shardings()
-        jit_dev = jax.jit(
+        jit_dev = self._jit(
             step_fn,
             in_shardings=(state_sh_dev, self.batch_sharding(batch, batch_dim=1), None),
             out_shardings=(state_sh_dev, metrics_shardings),
@@ -560,7 +613,7 @@ class DeepSpeedTpuEngine:
         metrics_shardings = StepMetrics(
             *([self._scalar_sharding] * len(StepMetrics._fields))
         )
-        return jax.jit(
+        return self._jit(
             step_fn,
             in_shardings=(self.state_shardings, self.batch_sharding(batch, batch_dim=1), None),
             out_shardings=(self.state_shardings, metrics_shardings),
@@ -588,6 +641,8 @@ class DeepSpeedTpuEngine:
             betas=tuple(op.get("betas", (0.9, 0.999))),
             eps=float(op.get("eps", 1e-8)),
             weight_decay=float(op.get("weight_decay", 0.0)),
+            num_threads=self.config.aio.thread_count,
+            queue_depth=self.config.aio.queue_depth,
         )
         place = jax.jit(
             lambda p: precision.cast_floating(p, self.compute_dtype),
@@ -641,7 +696,7 @@ class DeepSpeedTpuEngine:
                 grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
             return loss, grads, precision.global_grad_norm(grads)
 
-        jit_grad = jax.jit(
+        jit_grad = self._jit(
             grad_step,
             in_shardings=(
                 self.param_shardings,
@@ -655,7 +710,7 @@ class DeepSpeedTpuEngine:
                 self._scalar_sharding,
             ),
         )
-        upload = jax.jit(
+        upload = self._jit(
             lambda m: precision.cast_floating(m, self.compute_dtype),
             out_shardings=self.param_shardings,
         )
@@ -742,6 +797,15 @@ class DeepSpeedTpuEngine:
         if self.config.fp16.enabled and bool(metrics.skipped):
             self.skipped_steps += 1
         self.lr_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            # host-side mirror of the traced theta (monitoring/get_state();
+            # the traced step computes theta_at(step) itself)
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if (
+            self.eigenvalue is not None
+            and self.global_steps % self.eigenvalue.gas_boundary_resolution == 0
+        ):
+            self._compute_block_eigenvalue(batch)
         fp = self.config.flops_profiler
         profiling_now = fp.enabled and self.global_steps == fp.profile_step
         self.timers(STEP_GLOBAL_TIMER).stop(
@@ -767,6 +831,28 @@ class DeepSpeedTpuEngine:
                 reset=True,
             )
         return metrics.loss
+
+    def _compute_block_eigenvalue(self, batch) -> None:
+        """Power-iteration curvature estimate at the gas boundary (reference
+        engine.py:1503: eigenvalue drives compression scheduling).  Results
+        accumulate in ``self.block_eigenvalues`` as (step, value)."""
+        micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+        if not hasattr(self, "_eig_loss"):
+            # ONE wrapper object across steps: the estimator caches its
+            # compiled HVP keyed on this identity
+            def _eig_loss(p, b, r):
+                cp = precision.cast_floating(p, self.compute_dtype)
+                return self.loss_fn(cp, b, r)
+
+            self._eig_loss = _eig_loss
+        # fp32 primal regardless of offload mode (NVMe keeps bf16 compute
+        # copies in state.params) — tangents follow the primal dtype
+        masters = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32), self.state.params
+        )
+        ev, _ = self.eigenvalue.compute_eigenvalue(self._eig_loss, masters, micro)
+        self.block_eigenvalues.append((self.global_steps, ev))
+        log_dist(f"eigenvalue at step {self.global_steps}: {ev:.4e}")
 
     def _run_flops_profiler(self, batch) -> None:
         """Engine-integrated flops profiler firing at ``profile_step``
@@ -804,7 +890,7 @@ class DeepSpeedTpuEngine:
                 grads = zero.constrain(grads, self.master_shardings_dev)
                 return loss, grads
 
-            self._grad_fn = jax.jit(
+            self._grad_fn = self._jit(
                 micro_step,
                 in_shardings=(state_sh, self.batch_sharding(batch), None),
                 out_shardings=(self._scalar_sharding, self.master_shardings_dev),
@@ -855,7 +941,7 @@ class DeepSpeedTpuEngine:
                 new_state, _, finite = self._apply_grads(state, grad_sum, scale * gas)
                 return new_state, jnp.logical_not(finite)
 
-            self._apply_fn = jax.jit(
+            self._apply_fn = self._jit(
                 apply,
                 in_shardings=(state_sh, self.master_shardings_dev),
                 out_shardings=(state_sh, self._scalar_sharding),
@@ -889,7 +975,7 @@ class DeepSpeedTpuEngine:
                 cp = zero.constrain(cp, self.param_shardings)
                 return fn(cp, b, rng)
 
-            self._eval_step = jax.jit(ev)
+            self._eval_step = self._jit(ev)
         st = (
             jax.device_put(self.state, self._dev_state_shardings())
             if self._offload_cpu

@@ -53,9 +53,12 @@ class NVMeOptimizer:
         eps: float = 1e-8,
         weight_decay: float = 0.0,
         num_threads: int = 8,
+        queue_depth: int = 32,
     ):
         os.makedirs(swap_dir, exist_ok=True)
-        self.swapper = TensorSwapper(swap_dir, num_threads=num_threads)
+        self.swapper = TensorSwapper(
+            swap_dir, num_threads=num_threads, queue_depth=queue_depth
+        )
         self.opt = HostAdamW(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay)
         self._names: List[str] = []
         self._treedef = None

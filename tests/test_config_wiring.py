@@ -1,0 +1,236 @@
+"""r3 VERDICT weak #3: config keys must drive behavior, not be silently
+accepted.  Each test enables a formerly-passthrough key via the JSON config
+ONLY (no library calls) and asserts the subsystem actually engages."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.config.config import ConfigError, parse_config
+from deepspeed_tpu.models import CausalLM, get_preset
+
+
+def _base_config(**extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 1000,
+    }
+    cfg.update(extra)
+    return cfg
+
+
+def _batch(cfg, rng_seed=0, b=8, s=33):
+    rng = np.random.default_rng(rng_seed)
+    return {"input_ids": rng.integers(0, cfg.vocab_size, (b, s)).astype(np.int32)}
+
+
+# ---------------------------------------------------------------------------
+# progressive_layer_drop
+# ---------------------------------------------------------------------------
+def test_pld_config_drives_layer_drop():
+    """theta(t) = (1-p)exp(-gamma t) + p: with a huge gamma the schedule hits
+    its floor from step 1 on.  p ~ 0 drops nearly every layer (loss must
+    diverge from baseline at the second step); p = 1 keeps every layer
+    (trajectory identical to PLD off)."""
+    preset = get_preset("tiny", num_layers=4)
+    batch = _batch(preset)
+
+    losses = {}
+    for name, pld in [
+        ("off", None),
+        ("theta1", {"enabled": True, "theta": 1.0, "gamma": 1e9}),
+        ("theta0", {"enabled": True, "theta": 1e-6, "gamma": 1e9}),
+    ]:
+        cfg = _base_config()
+        if pld is not None:
+            cfg["progressive_layer_drop"] = pld
+        model = CausalLM(preset)
+        engine, _, _, _ = ds.initialize(model=model, config=cfg)
+        engine.train_batch(batch)  # step 0 traces theta(0) = 1: all kept
+        losses[name] = float(engine.train_batch(batch))
+        if pld is not None:
+            assert engine.progressive_layer_drop is not None
+            # host-side theta mirror reached the schedule floor
+            assert engine.progressive_layer_drop.get_theta() == pytest.approx(
+                pld["theta"], abs=1e-5
+            )
+    assert losses["theta1"] == pytest.approx(losses["off"], abs=2e-3)
+    assert abs(losses["theta0"] - losses["off"]) > 1e-2, losses
+
+
+def test_pld_requires_model_adapter():
+    def loss_fn(p, batch, rng):
+        return jnp.sum(p["w"] ** 2)
+
+    with pytest.raises(ConfigError, match="progressive_layer_drop"):
+        ds.initialize(
+            loss_fn=loss_fn,
+            params={"w": jnp.ones((4, 4))},
+            config=_base_config(
+                progressive_layer_drop={"enabled": True, "theta": 0.5}
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# eigenvalue
+# ---------------------------------------------------------------------------
+def test_eigenvalue_config_runs_power_iteration():
+    preset = get_preset("tiny", num_layers=2)
+    model = CausalLM(preset)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config=_base_config(
+            eigenvalue={
+                "enabled": True,
+                "max_iter": 3,
+                "gas_boundary_resolution": 2,
+                "tol": 1e-2,
+            }
+        ),
+    )
+    batch = _batch(preset)
+    for _ in range(4):
+        engine.train_batch(batch)
+    # resolution=2 over 4 steps -> estimates at steps 2 and 4
+    assert len(engine.block_eigenvalues) == 2
+    for step, ev in engine.block_eigenvalues:
+        assert np.isfinite(ev)
+
+
+# ---------------------------------------------------------------------------
+# sparse_attention
+# ---------------------------------------------------------------------------
+def test_sparse_attention_config_changes_attention():
+    """A fixed layout with a small local window must change the logits vs
+    dense attention (and match the ops-level block_sparse_attention)."""
+    preset = get_preset("tiny", num_layers=2, max_seq_len=64)
+    batch = _batch(preset, s=64)
+
+    losses = {}
+    for name, extra in [
+        ("dense", {}),
+        ("sparse", {"sparse_attention": {
+            "mode": "fixed", "block": 16, "num_local_blocks": 2,
+            "num_global_blocks": 0,
+        }}),
+    ]:
+        model = CausalLM(preset)
+        engine, _, _, _ = ds.initialize(model=model, config=_base_config(**extra))
+        losses[name] = float(engine.train_batch({
+            "input_ids": batch["input_ids"], "labels": batch["input_ids"],
+        }))
+        if name == "sparse":
+            assert model.cfg.sparse_attention is not None
+    assert abs(losses["sparse"] - losses["dense"]) > 1e-3, losses
+
+
+def test_sparse_attention_mode_validated():
+    with pytest.raises(ConfigError, match="sparse_attention.mode"):
+        parse_config({"sparse_attention": {"mode": "tropical"}})
+
+
+def test_sparse_attention_requires_model():
+    with pytest.raises(ConfigError, match="sparse_attention"):
+        ds.initialize(
+            loss_fn=lambda p, b, r: jnp.sum(p["w"] ** 2),
+            params={"w": jnp.ones((4, 4))},
+            config=_base_config(sparse_attention={"mode": "fixed"}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# compile.disable
+# ---------------------------------------------------------------------------
+def test_compile_disable_runs_eager():
+    preset = get_preset("tiny", num_layers=2)
+    batch = _batch(preset)
+    ref_engine, _, _, _ = ds.initialize(model=CausalLM(preset), config=_base_config())
+    eager_engine, _, _, _ = ds.initialize(
+        model=CausalLM(preset), config=_base_config(compile={"disable": True})
+    )
+    # eager mode: the step function is NOT a jit-compiled callable
+    assert eager_engine._jit(lambda x: x) is not None
+    probe = lambda x: x
+    assert eager_engine._jit(probe) is probe
+    assert ref_engine._jit(probe) is not probe
+    l_ref = [float(ref_engine.train_batch(batch)) for _ in range(2)]
+    l_eager = [float(eager_engine.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(l_eager, l_ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# hybrid_engine / nebula / legacy curriculum / aio
+# ---------------------------------------------------------------------------
+def test_hybrid_engine_config_wraps_engine():
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+    preset = get_preset("tiny", num_layers=2)
+    engine, _, _, _ = ds.initialize(
+        model=CausalLM(preset),
+        config=_base_config(hybrid_engine={"enabled": True}),
+    )
+    assert isinstance(engine, DeepSpeedHybridEngine)
+    batch = _batch(preset)
+    first = float(engine.train_batch(batch))
+    from deepspeed_tpu.inference.sampling import SamplingParams
+
+    out = engine.generate([3, 5, 7], SamplingParams(temperature=0.0, max_new_tokens=4))
+    assert len(out) <= 4 and all(isinstance(t, int) for t in out)
+
+
+def test_nebula_maps_to_async_checkpointing():
+    cfg = parse_config({"nebula": {"enabled": True, "persistent_storage_path": "/tmp/x"}})
+    assert cfg.checkpoint.async_save is True
+
+
+def test_legacy_curriculum_learning_key_maps():
+    cfg = parse_config({
+        "curriculum_learning": {
+            "enabled": True,
+            "curriculum_type": "seqlen",
+            "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+        }
+    })
+    assert cfg.data_efficiency.enabled
+    assert cfg.data_efficiency.curriculum_learning["curriculum_type"] == "seqlen"
+
+
+def test_aio_config_reaches_nvme_engine(tmp_path):
+    import deepspeed_tpu.runtime.offload as offload_mod
+
+    seen = {}
+    orig = offload_mod.TensorSwapper
+
+    class Spy(orig):
+        def __init__(self, swap_dir, num_threads=8, queue_depth=32):
+            seen["threads"] = num_threads
+            seen["depth"] = queue_depth
+            super().__init__(swap_dir, num_threads=num_threads, queue_depth=queue_depth)
+
+    offload_mod.TensorSwapper = Spy
+    try:
+        preset = get_preset("tiny", num_layers=2)
+        engine, _, _, _ = ds.initialize(
+            model=CausalLM(preset),
+            config=_base_config(
+                zero_optimization={
+                    "stage": 2,
+                    "offload_optimizer": {
+                        "device": "nvme", "nvme_path": str(tmp_path)
+                    },
+                },
+                bf16={"enabled": True},
+                aio={"thread_count": 3, "queue_depth": 11},
+            ),
+        )
+    finally:
+        offload_mod.TensorSwapper = orig
+    assert seen == {"threads": 3, "depth": 11}
