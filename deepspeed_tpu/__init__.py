@@ -110,6 +110,12 @@ def initialize(
             model_cfg = config_from_hf(_json.load(fh))
         model = CausalLM(model_cfg)
 
+    def _set_model_cfg(m, new_cfg):
+        m.cfg = new_cfg
+        inner = getattr(m, "_inner", None)
+        if inner is not None and hasattr(inner, "cfg"):
+            inner.cfg = new_cfg
+
     aq = (cfg.compression_training.activation_quantization or {})
     if (
         aq.get("shared_parameters", {}).get("enabled")
@@ -123,7 +129,7 @@ def initialize(
         groups = aq.get("different_groups", {}) or {}
         first = next(iter(groups.values()), {})
         bits = int(first.get("params", {}).get("bits", 8))
-        model.cfg = model.cfg.replace(act_quant_bits=bits)
+        _set_model_cfg(model, model.cfg.replace(act_quant_bits=bits))
         log_dist(f"activation quantization: {bits}-bit STE on sublayer inputs")
 
     if cfg.sparse_attention.mode:
@@ -141,19 +147,24 @@ def initialize(
                 "(ring attention supplies its own attention body)"
             )
         sp = cfg.sparse_attention.build()
-        model.cfg = model.cfg.replace(sparse_attention=sp)
+        _set_model_cfg(model, model.cfg.replace(sparse_attention=sp))
         log_dist(
             f"sparse attention: mode={cfg.sparse_attention.mode} "
             f"block={sp.block}"
         )
 
-    if cfg.progressive_layer_drop.enabled and (
-        model is None or not hasattr(model, "cfg")
-    ):
-        raise ConfigError(
-            "progressive_layer_drop requires model= (a models.CausalLM) so "
-            "the engine can thread the per-step layer-keep mask"
-        )
+    if cfg.progressive_layer_drop.enabled:
+        if model is None or not hasattr(model, "cfg"):
+            raise ConfigError(
+                "progressive_layer_drop requires model= (a models.CausalLM) "
+                "so the engine can thread the per-step layer-keep mask"
+            )
+        if getattr(model, "_inner", None) is not None:
+            raise ConfigError(
+                "progressive_layer_drop is not supported on the pipelined "
+                "stack (per-stage layer-keep routing pending); use a dense "
+                "CausalLM or disable PLD"
+            )
 
     if model is not None and loss_fn is None:
         loss_fn = model.loss_fn
@@ -285,6 +296,13 @@ def initialize(
         # the returned engine for the RLHF train<->generate wrapper
         from .runtime.hybrid_engine import DeepSpeedHybridEngine
 
-        engine = DeepSpeedHybridEngine(engine)
+        if cfg.hybrid_engine.inference_tp_size != 1:
+            raise ConfigError(
+                "hybrid_engine.inference_tp_size is not supported: hybrid "
+                "serving follows the training mesh (set mesh.model for TP)"
+            )
+        engine = DeepSpeedHybridEngine(
+            engine, max_out_tokens=cfg.hybrid_engine.max_out_tokens
+        )
         log_dist("hybrid engine enabled: generate() serves the live weights")
     return engine, engine, dataloader, engine.lr_scheduler

@@ -40,8 +40,12 @@ class DeepSpeedHybridEngine:
         num_blocks: int = 256,
         block_size: int = 32,
         max_seq_len: Optional[int] = None,
+        max_out_tokens: Optional[int] = None,
         **inference_kw,
     ):
+        # reference hybrid_engine config: max_out_tokens bounds generation
+        # length per call (config.py HybridEngineConfig)
+        self.max_out_tokens = max_out_tokens
         model = getattr(engine, "model", None)
         if model is None or not hasattr(model, "cfg"):
             raise ValueError(
@@ -94,6 +98,18 @@ class DeepSpeedHybridEngine:
         self._params_step = int(self.engine.global_steps)
 
     # -- generate ------------------------------------------------------------
+    def _clamp(self, sampling: SamplingParams) -> SamplingParams:
+        if (
+            self.max_out_tokens is not None
+            and sampling.max_new_tokens > self.max_out_tokens
+        ):
+            import dataclasses
+
+            return dataclasses.replace(
+                sampling, max_new_tokens=self.max_out_tokens
+            )
+        return sampling
+
     def generate(
         self,
         prompt_tokens: Sequence[int],
@@ -101,7 +117,7 @@ class DeepSpeedHybridEngine:
     ) -> List[int]:
         if int(self.engine.global_steps) != self._params_step:
             self.refresh()
-        return self._inference.generate(prompt_tokens, sampling)
+        return self._inference.generate(prompt_tokens, self._clamp(sampling))
 
     def generate_batch(
         self,
@@ -111,6 +127,7 @@ class DeepSpeedHybridEngine:
         """Batched RLHF rollout: packed prefill + shared decode ticks."""
         if int(self.engine.global_steps) != self._params_step:
             self.refresh()
+        sampling = self._clamp(sampling)
         inf = self._inference
         base = max(inf.mgr.seqs, default=0) + 1  # never collide with live uids
         uids = list(range(base, base + len(prompts)))

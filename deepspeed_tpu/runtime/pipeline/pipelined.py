@@ -366,10 +366,11 @@ class PipelinedCausalLM:
         path, unduplicated.  Returns (x, moe_aux) — MoE blocks compose with
         the pipeline (expert weights run dense-locally per stage shard; the
         aux loss is validity-gated per tick and psum'd across stages)."""
-        from ...models.transformer import decoder_layer
-        from ...ops.attention import get_attention_impl
+        from ...models.transformer import _get_attn_fn, decoder_layer
 
-        attn_fn = get_attention_impl(self.cfg.attn_impl)
+        # the cfg-driven dispatch (sparse layouts included) — NOT the raw
+        # impl lookup, which would silently drop cfg.sparse_attention
+        attn_fn = _get_attn_fn(self.cfg)
         # positions are identical for every microbatch; use the 1-D [s] form
         # so the layer body broadcasts over whatever microbatch size it sees
         pos1d = positions[0] if positions.ndim == 2 else positions

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.sharding import shard_activation
+from ..parallel.sharding import mesh_disabled, shard_activation
 from ..parallel.topology import DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, SUB_AXIS
 
 BATCH = (DATA_AXIS, FSDP_AXIS, SUB_AXIS)
@@ -43,18 +43,107 @@ def ulysses_spec(phase: str) -> P:
 class DistributedAttention:
     """Callable with the ops.attention signature; wraps any local attention.
 
-    reference: sequence/layer.py:311 — same role, zero lines of comm code.
+    reference: sequence/layer.py:311 — same role, zero lines of comm code for
+    the even case.  GQA below the SP degree (hkv < seq axis P, e.g. llama3's
+    8 kv heads under P=32) takes the *uneven-heads* path (the reference's
+    ``uneven_heads_all2all``, layer.py:111), implemented TPU-style as grouped
+    collectives in a shard_map: factor P = hkv x G, give each G-device group
+    one kv head via a grouped all-to-all, and assemble that head's full
+    sequence with a grouped all-gather of size G — per-device kv memory and
+    comm volume are hkv-times smaller than the replication fallback.
     """
 
     def __init__(self, local_attention: Callable):
         self.local_attention = local_attention
 
     def __call__(self, q, k, v, **kw):
+        out = self._gqa_uneven_heads(q, k, v, kw)
+        if out is not None:
+            return out
         q = shard_activation(q, ulysses_spec("head"))
         k = shard_activation(k, ulysses_spec("head"))
         v = shard_activation(v, ulysses_spec("head"))
         out = self.local_attention(q, k, v, **kw)
         return shard_activation(out, ulysses_spec("sequence"))
+
+    def _gqa_uneven_heads(self, q, k, v, kw):
+        """Manual grouped-collective path for hkv < P; None = not applicable
+        (the GSPMD path then applies, replicating kv heads when they don't
+        divide — correct but hkv-times the memory/comm)."""
+        from ..parallel.sharding import filter_spec, get_current_mesh
+
+        mesh = get_current_mesh()
+        if mesh is None:
+            return None
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        sp = sizes.get(SEQ_AXIS, 1)
+        hq, hkv = q.shape[2], k.shape[2]
+        s = q.shape[1]
+        q_offset = kw.get("q_offset", 0)
+        if not (
+            sp > 1
+            and hkv < sp
+            and sp % hkv == 0
+            and hq % sp == 0
+            and s % sp == 0
+            and s == k.shape[1]
+            and sizes.get(MODEL_AXIS, 1) == 1
+            and kw.get("segment_ids") is None
+            and kw.get("kv_segment_ids") is None
+            and isinstance(q_offset, int)
+            and q_offset == 0
+        ):
+            return None
+        G = sp // hkv
+        # device p = g*G + j: inner groups share the kv head g, cross groups
+        # share the inner index j
+        j_groups = [[g * G + j for j in range(G)] for g in range(hkv)]
+        g_groups = [[g * G + j for g in range(hkv)] for j in range(G)]
+        attn = self.local_attention
+        kw_inner = dict(kw)
+
+        def body(ql, kl, vl):
+            # q: plain seq->head all-to-all over the whole axis
+            qh = jax.lax.all_to_all(
+                ql, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
+            )
+
+            def redistribute(x):
+                # 1) grouped a2a (cross-g, size hkv): each device keeps ONE
+                #    kv head — its group's — for the chunks of its cross-group
+                xh = jax.lax.all_to_all(
+                    x, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True,
+                    axis_index_groups=g_groups,
+                )  # [b, hkv*(s/P), 1, d], chunks g'-major at fixed j
+                # 2) grouped gather (within-g, size G): full sequence of that
+                #    head — this is the collective that is G-wide, not P-wide
+                xg = jax.lax.all_gather(
+                    xh, SEQ_AXIS, axis=1, tiled=True,
+                    axis_index_groups=j_groups,
+                )  # [b, s, 1, d], j-major chunk order
+                b, s_, h1, d_ = xg.shape
+                chunk = s_ // (G * hkv)
+                # restore ascending sequence order: (j, g') -> (g', j)
+                return (
+                    xg.reshape(b, G, hkv, chunk, h1, d_)
+                    .transpose(0, 2, 1, 3, 4, 5)
+                    .reshape(b, s_, h1, d_)
+                )
+
+            with mesh_disabled():
+                out = attn(qh, redistribute(kl), redistribute(vl), **kw_inner)
+            # back to the sequence-sharded resting layout
+            return jax.lax.all_to_all(
+                out, SEQ_AXIS, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        batch_entry = filter_spec((q.shape[0],), P(BATCH), mesh)[0]
+        spec = P(batch_entry, SEQ_AXIS, None, None)
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
 
 
 def single_all_to_all(x: jnp.ndarray, scatter_idx: int, gather_idx: int, axis_name: str):
