@@ -135,6 +135,92 @@ def _cap_bwd_factor(s_cap, soft_cap):
     return 1.0 - (s_cap / soft_cap) ** 2
 
 
+
+def _fwd_block_update(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, m_s, l_s,
+                      acc_s, iq, ik, *, scale, bq, bk, has_seg, soft_cap):
+    """One online-softmax accumulation step over kv block ``ik`` — shared by
+    the dense and sparse forward kernels (only the ik source differs)."""
+    qb = q_ref[0]  # [bq, d]
+    kb = k_ref[0]  # [bk, d]
+    s = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+    s, _ = _mask_and_cap(
+        s, iq, ik, bq, bk,
+        qseg_ref[0, :, 0] if has_seg else None,
+        kseg_ref[0, :, 0] if has_seg else None,
+        soft_cap,
+    )
+    m_prev = m_s[:]  # [bq, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)  # [bq, bk]
+    l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_s[:] = m_new
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_s[:] = acc_s[:] * alpha + pv
+
+
+def _fwd_finalize(o_ref, lse_ref, m_s, l_s, acc_s):
+    l = l_s[:]
+    o_ref[0] = (acc_s[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = m_s[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _dq_block_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     qseg_ref, kseg_ref, dq_s, iq, ik, *, scale, bq, bk,
+                     has_seg, soft_cap):
+    qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
+    p, cap_f = _recompute_p(
+        qb, kb, lse_ref[0], iq, ik, bq, bk,
+        qseg_ref[0, :, 0] if has_seg else None,
+        kseg_ref[0, :, 0] if has_seg else None,
+        scale, soft_cap,
+    )
+    dp = jax.lax.dot_general(
+        do_ref[0], vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_ref[0])
+    if cap_f is not None:
+        ds = ds * cap_f
+    ds = ds * scale
+    dq_s[:] += jax.lax.dot_general(
+        ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dkv_block_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      qseg_ref, kseg_ref, dk_s, dv_s, iq, ik, *, scale, bq,
+                      bk, has_seg, soft_cap):
+    qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
+    p, cap_f = _recompute_p(
+        qb, kb, lse_ref[0], iq, ik, bq, bk,
+        qseg_ref[0, :, 0] if has_seg else None,
+        kseg_ref[0, :, 0] if has_seg else None,
+        scale, soft_cap,
+    )
+    dob = do_ref[0]
+    dv_s[:] += jax.lax.dot_general(
+        p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_ref[0])
+    if cap_f is not None:
+        ds = ds * cap_f
+    ds = ds * scale
+    dk_s[:] += jax.lax.dot_general(
+        ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -155,34 +241,13 @@ def _fwd_kernel(*refs, scale, bq, bk, has_seg, soft_cap):
     # skip fully-masked kv blocks (strictly above the diagonal)
     @pl.when(ik * bk <= iq * bq + (bq - 1))
     def _():
-        qb = q_ref[0]  # [bq, d]
-        kb = k_ref[0]  # [bk, d]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
-        s, _ = _mask_and_cap(
-            s, iq, ik, bq, bk,
-            qseg_ref[0, :, 0] if has_seg else None,
-            kseg_ref[0, :, 0] if has_seg else None,
-            soft_cap,
-        )
-        m_prev = m_s[:]  # [bq, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)  # [bq, bk]
-        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_s[:] = m_new
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_s[:] = acc_s[:] * alpha + pv
+        _fwd_block_update(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, m_s, l_s,
+                          acc_s, iq, ik, scale=scale, bq=bq, bk=bk,
+                          has_seg=has_seg, soft_cap=soft_cap)
 
     @pl.when(ik == pl.num_programs(2) - 1)
     def _():
-        l = l_s[:]
-        o_ref[0] = (acc_s[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = m_s[:] + jnp.log(jnp.maximum(l, 1e-30))
+        _fwd_finalize(o_ref, lse_ref, m_s, l_s, acc_s)
 
 
 def _fwd(q, k, v, qseg, kseg, scale, soft_cap):
@@ -260,24 +325,9 @@ def _dq_kernel(*refs, scale, bq, bk, has_seg, soft_cap):
 
     @pl.when(ik * bk <= iq * bq + (bq - 1))
     def _():
-        qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
-        p, cap_f = _recompute_p(
-            qb, kb, lse_ref[0], iq, ik, bq, bk,
-            qseg_ref[0, :, 0] if has_seg else None,
-            kseg_ref[0, :, 0] if has_seg else None,
-            scale, soft_cap,
-        )
-        dp = jax.lax.dot_general(
-            do_ref[0], vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta_ref[0])
-        if cap_f is not None:
-            ds = ds * cap_f
-        ds = ds * scale
-        dq_s[:] += jax.lax.dot_general(
-            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        _dq_block_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         qseg_ref, kseg_ref, dq_s, iq, ik, scale=scale,
+                         bq=bq, bk=bk, has_seg=has_seg, soft_cap=soft_cap)
 
     @pl.when(ik == pl.num_programs(2) - 1)
     def _():
@@ -301,29 +351,9 @@ def _dkv_kernel(*refs, scale, bq, bk, has_seg, soft_cap):
 
     @pl.when(iq * bq + (bq - 1) >= ik * bk)
     def _():
-        qb, kb, vb = q_ref[0], k_ref[0], v_ref[0]
-        p, cap_f = _recompute_p(
-            qb, kb, lse_ref[0], iq, ik, bq, bk,
-            qseg_ref[0, :, 0] if has_seg else None,
-            kseg_ref[0, :, 0] if has_seg else None,
-            scale, soft_cap,
-        )
-        dob = do_ref[0]
-        dv_s[:] += jax.lax.dot_general(
-            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta_ref[0])
-        if cap_f is not None:
-            ds = ds * cap_f
-        ds = ds * scale
-        dk_s[:] += jax.lax.dot_general(
-            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        _dkv_block_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          qseg_ref, kseg_ref, dk_s, dv_s, iq, ik, scale=scale,
+                          bq=bq, bk=bk, has_seg=has_seg, soft_cap=soft_cap)
 
     @pl.when(iq == pl.num_programs(2) - 1)
     def _():
@@ -439,6 +469,326 @@ def _flash_bwd(scale, soft_cap, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# block-sparse variant: the grid is driven by static tables of ACTIVE kv
+# blocks per q block (and transposed for dkv), so masked blocks are never
+# fetched or computed — the compute-skipping the reference's triton
+# block-sparse matmuls (ops/sparse_attention/matmul.py SDD/DSD) deliver,
+# expressed as scalar-prefetch indexed BlockSpecs.  Kernel block size ==
+# layout block size: the layout's semantics are preserved exactly.
+# ---------------------------------------------------------------------------
+def _sparse_tables(layout, causal):
+    """layout [n, n] bool (numpy) -> hashable (tbl, counts, tblT, countsT);
+    None when some q row has no active block under the causal trim (the
+    online softmax would emit garbage lse for it)."""
+    n = layout.shape[0]
+    rows = []
+    for i in range(n):
+        ks = [j for j in range(n) if layout[i, j] and (not causal or j <= i)]
+        if not ks:
+            return None
+        rows.append(ks)
+    max_a = max(len(r) for r in rows)
+    tbl = tuple(tuple(r + [r[-1]] * (max_a - len(r))) for r in rows)
+    counts = tuple(len(r) for r in rows)
+    cols = [
+        [i for i in range(n) if layout[i, j] and (not causal or j <= i)]
+        for j in range(n)
+    ]
+    max_t = max(1, max(len(c) for c in cols))
+    tblT = tuple(
+        tuple(c + [c[-1] if c else 0] * (max_t - len(c))) for c in cols
+    )
+    countsT = tuple(len(c) for c in cols)
+    return tbl, counts, tblT, countsT
+
+
+def _fwd_sparse_kernel(tbl_ref, cnt_ref, *refs, scale, bq, bk, has_seg, soft_cap):
+    if has_seg:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        qseg_ref = kseg_ref = None
+    iq, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    @pl.when(j < cnt_ref[iq])
+    def _():
+        ik = tbl_ref[iq, j]  # REAL kv block index (for position masking)
+        _fwd_block_update(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, m_s, l_s,
+                          acc_s, iq, ik, scale=scale, bq=bq, bk=bk,
+                          has_seg=has_seg, soft_cap=soft_cap)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        _fwd_finalize(o_ref, lse_ref, m_s, l_s, acc_s)
+
+
+def _dq_sparse_kernel(tbl_ref, cnt_ref, *refs, scale, bq, bk, has_seg, soft_cap):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+         dq_ref, dq_s) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s = refs
+        qseg_ref = kseg_ref = None
+    iq, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    @pl.when(j < cnt_ref[iq])
+    def _():
+        ik = tbl_ref[iq, j]
+        _dq_block_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         qseg_ref, kseg_ref, dq_s, iq, ik, scale=scale,
+                         bq=bq, bk=bk, has_seg=has_seg, soft_cap=soft_cap)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _dkv_sparse_kernel(tbl_ref, cnt_ref, *refs, scale, bq, bk, has_seg, soft_cap):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+        qseg_ref = kseg_ref = None
+    ik, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    @pl.when(j < cnt_ref[ik])
+    def _():
+        iq = tbl_ref[ik, j]
+        _dkv_block_update(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          qseg_ref, kseg_ref, dk_s, dv_s, iq, ik, scale=scale,
+                          bq=bq, bk=bk, has_seg=has_seg, soft_cap=soft_cap)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _fwd_sparse(q, k, v, qseg, kseg, scale, soft_cap, tables, block):
+    bh, s, d = q.shape
+    bh_kv = k.shape[0]
+    n_rep = bh // bh_kv
+    tbl, counts, _, _ = tables
+    max_a = len(tbl[0])
+    has_seg = qseg is not None
+    hq_pb = bh // qseg.shape[0] if has_seg else 1
+    tbl_arr = jnp.asarray(tbl, jnp.int32)
+    cnt_arr = jnp.asarray(counts, jnp.int32)
+    kernel = functools.partial(
+        _fwd_sparse_kernel, scale=scale, bq=block, bk=block,
+        has_seg=has_seg, soft_cap=soft_cap,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h, i, 0)),
+        pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h // n_rep, tb[i, j], 0)),
+        pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h // n_rep, tb[i, j], 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h // hq_pb, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h // hq_pb, tb[i, j], 0)),
+        ]
+        operands += [qseg, kseg]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, s // block, max_a),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block, 1), jnp.float32),
+                pltpu.VMEM((block, 1), jnp.float32),
+                pltpu.VMEM((block, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(tbl_arr, cnt_arr, *operands)
+    return out, lse
+
+
+def _bwd_sparse(scale, soft_cap, tables, block, res, do):
+    q, k_rep, v_rep, qseg, kseg, out, lse = res
+    bh, s, d = q.shape
+    tbl, counts, tblT, countsT = tables
+    has_seg = qseg is not None
+    hq_pb = bh // qseg.shape[0] if has_seg else 1
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    tbl_arr = jnp.asarray(tbl, jnp.int32)
+    cnt_arr = jnp.asarray(counts, jnp.int32)
+    tblT_arr = jnp.asarray(tblT, jnp.int32)
+    cntT_arr = jnp.asarray(countsT, jnp.int32)
+
+    qspec = pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h, i, 0))
+    kspec_tbl = pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h, tb[i, j], 0))
+    lspec = pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h, i, 0))
+    in_specs = [qspec, kspec_tbl, kspec_tbl, qspec, lspec, lspec]
+    operands = [q, k_rep, v_rep, do, lse, delta]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h // hq_pb, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h // hq_pb, tb[i, j], 0)),
+        ]
+        operands += [qseg, kseg]
+    dq = pl.pallas_call(
+        functools.partial(_dq_sparse_kernel, scale=scale, bq=block, bk=block,
+                          has_seg=has_seg, soft_cap=soft_cap),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, s // block, len(tbl[0])),
+            in_specs=in_specs,
+            out_specs=[qspec],
+            scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
+        interpret=_INTERPRET,
+    )(tbl_arr, cnt_arr, *operands)[0]
+
+    kspec = pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h, i, 0))
+    qspec_tbl = pl.BlockSpec((1, block, d), lambda h, i, j, tb, cn: (h, tb[i, j], 0))
+    lspec_tbl = pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h, tb[i, j], 0))
+    in_specs2 = [qspec_tbl, kspec, kspec, qspec_tbl, lspec_tbl, lspec_tbl]
+    operands2 = [q, k_rep, v_rep, do, lse, delta]
+    if has_seg:
+        in_specs2 += [
+            pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h // hq_pb, tb[i, j], 0)),
+            pl.BlockSpec((1, block, 1), lambda h, i, j, tb, cn: (h // hq_pb, i, 0)),
+        ]
+        operands2 += [qseg, kseg]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_sparse_kernel, scale=scale, bq=block, bk=block,
+                          has_seg=has_seg, soft_cap=soft_cap),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, s // block, len(tblT[0])),
+            in_specs=in_specs2,
+            out_specs=[kspec, kspec],
+            scratch_shapes=[
+                pltpu.VMEM((block, d), jnp.float32),
+                pltpu.VMEM((block, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k_rep.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v_rep.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(tblT_arr, cntT_arr, *operands2)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_sparse(q, k, v, qseg, kseg, scale, soft_cap, tables, block):
+    out, _ = _fwd_sparse(q, k, v, qseg, kseg, scale, soft_cap, tables, block)
+    return out
+
+
+def _flash_sparse_fwd(q, k, v, qseg, kseg, scale, soft_cap, tables, block):
+    out, lse = _fwd_sparse(q, k, v, qseg, kseg, scale, soft_cap, tables, block)
+    return out, (q, k, v, qseg, kseg, out, lse)
+
+
+def _flash_sparse_bwd(scale, soft_cap, tables, block, res, do):
+    q, k, v, qseg, kseg, out, lse = res
+    n_rep = q.shape[0] // k.shape[0]
+    res_rep = (q, _repeat_heads(k, n_rep), _repeat_heads(v, n_rep), qseg,
+               kseg, out, lse)
+    dq, dk_rep, dv_rep = _bwd_sparse(scale, soft_cap, tables, block, res_rep, do)
+    return (dq, _reduce_heads(dk_rep, n_rep), _reduce_heads(dv_rep, n_rep),
+            None, None)
+
+
+_flash_sparse.defvjp(_flash_sparse_fwd, _flash_sparse_bwd)
+
+
+def sparse_supports(q, k, v, layout_block: int, causal: bool, q_offset,
+                    segment_ids) -> bool:
+    """Applicability of the compute-skipping sparse kernel: the layout block
+    must BE a viable kernel block (>= 128, tile-aligned) — finer layouts run
+    the masked dense body."""
+    if not causal:
+        return False
+    if not isinstance(q_offset, int) or q_offset != 0:
+        return False
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    if sq != sk:
+        return False
+    if layout_block < 128 or sq % layout_block:
+        return False
+    if d not in (64, 128, 256):
+        return False
+    if hq % hk != 0:
+        return False
+    if segment_ids is not None and tuple(segment_ids.shape) != (b, sq):
+        return False
+    return True
+
+
+def pallas_block_sparse_attention(
+    q, k, v, layout, layout_block: int, causal=True, scale=None,
+    segment_ids=None, kv_segment_ids=None, logits_soft_cap=None,
+):
+    """Compute-skipping block-sparse attention.  ``layout`` is the
+    [s/block, s/block] bool numpy mask (SparsityConfig.make_layout); masked
+    blocks are never fetched or computed.  Returns None when the layout has
+    an empty causal row (callers fall back to the masked dense body)."""
+    if not causal:
+        raise ValueError(
+            "pallas_block_sparse_attention is causal-only (the kernels "
+            "hard-code the causal mask); use the masked dense body"
+        )
+    tables = _sparse_tables(layout, causal)
+    if tables is None:
+        return None
+    b, s, hq, d = q.shape
+    scale = float(scale) if scale is not None else float(d) ** -0.5
+    cap = float(logits_soft_cap) if logits_soft_cap is not None else None
+
+    def to_hm(x):
+        xb, xs, xh, xd = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(xb * xh, xs, xd)
+
+    qseg = kseg = None
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        qseg = segment_ids.astype(jnp.int32)[:, :, None]
+        kseg = kv_seg.astype(jnp.int32)[:, :, None]
+
+    out = _flash_sparse(
+        to_hm(q), to_hm(k), to_hm(v), qseg, kseg, scale, cap, tables,
+        layout_block,
+    )
+    return out.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
 
 
 def pallas_flash_attention(

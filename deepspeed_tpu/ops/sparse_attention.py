@@ -6,11 +6,12 @@ softmax kernels driven by ``sparsity_config.py`` pattern classes
 ``BSLongformerSparsityConfig``; selected via runtime/config.py:324-445).
 
 TPU formulation: patterns build a **block-level mask** [n_q_blocks,
-n_k_blocks]; attention applies it as an element mask in the fused XLA body
-(`block_sparse_attention`).  XLA's fusion already avoids materializing the
-masked softmax poorly, and the block mask composes with causal masking; the
-Pallas flash kernel covers the dense-causal hot path, while these patterns
-serve the reference's long-sequence sparse configs.
+n_k_blocks].  When the layout block is a viable kernel tile (>= 128), the
+Pallas block-sparse flash kernel runs it COMPUTE-SKIPPING: active kv blocks
+per q block become a static scalar-prefetch table driving the grid, so
+masked blocks are never fetched or computed (the triton SDD/DSD analogue;
+FLOP-proportional speedup, ops/pallas/flash_kernel.py).  Finer layouts fall
+back to an element mask in the fused XLA body — correct, dense cost.
 """
 from __future__ import annotations
 
@@ -160,12 +161,11 @@ def block_sparse_attention(
 ):
     """[b, s, h, d] attention restricted to the config's block layout.
 
-    Delegates to ``dot_product_attention`` with the layout expanded to an
-    element mask, so segments/soft-cap/GQA behave identically to the rest of
-    the stack.  NOTE: compute and memory are DENSE (masked softmax) — the
-    block layout controls semantics, not cost; for actual long-sequence
-    memory savings use the flash kernel (causal) or ring attention.  A
-    block-skipping Pallas variant is the open item.
+    Kernel-tile-aligned layouts (block >= 128) run the compute-skipping
+    Pallas kernel: masked blocks are never fetched or computed, so cost is
+    proportional to the active-block count.  Finer layouts delegate to
+    ``dot_product_attention`` with the layout expanded to an element mask
+    (dense cost, identical semantics).
 
     Decode steps (``sq != sk``, cached KV) fall back to dense attention —
     sparse layouts are a training/prefill construct (the reference's
@@ -180,8 +180,29 @@ def block_sparse_attention(
             segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
             logits_soft_cap=logits_soft_cap,
         )
-    layout = jnp.asarray(config.make_layout(s))
-    elem = jnp.repeat(jnp.repeat(layout, config.block, 0), config.block, 1)
+    layout_np = config.make_layout(s)
+    # compute-skipping Pallas path: masked blocks are never fetched or
+    # computed (the reference triton SDD/DSD analogue) — requires the layout
+    # block to be a viable kernel tile; otherwise the masked dense body
+    from .pallas.flash_attention import is_compatible
+    from .pallas.flash_kernel import (
+        _INTERPRET,
+        pallas_block_sparse_attention,
+        sparse_supports,
+    )
+
+    if (is_compatible() or _INTERPRET) and sparse_supports(
+        q, k, v, config.block, causal, q_offset, segment_ids
+    ):
+        out = pallas_block_sparse_attention(
+            q, k, v, layout_np, config.block, causal=causal, scale=scale,
+            segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
+            logits_soft_cap=logits_soft_cap,
+        )
+        if out is not None:
+            return out
+    elem = jnp.repeat(jnp.repeat(jnp.asarray(layout_np), config.block, 0),
+                      config.block, 1)
     return dot_product_attention(
         q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
         kv_segment_ids=kv_segment_ids, logits_soft_cap=logits_soft_cap,

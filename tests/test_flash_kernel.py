@@ -193,3 +193,135 @@ def test_flash_bwd_block_override_parity():
     finally:
         fk.set_block_sizes(None, None)
         fk.set_interpret(False)
+
+
+# ---------------------------------------------------------------------------
+# r4: compute-skipping block-sparse kernel (VERDICT r3 #8)
+# ---------------------------------------------------------------------------
+def _sparse_qkv(b, s, hq, hkv, d, seed=9):
+    return (_rand((b, s, hq, d), seed), _rand((b, s, hkv, d), seed + 1),
+            _rand((b, s, hkv, d), seed + 2))
+
+
+def test_block_sparse_kernel_matches_masked_dense():
+    """Local-window layout at kernel granularity: the sparse kernel must
+    equal the element-masked dense body (values AND grads), GQA included."""
+    from deepspeed_tpu.ops.pallas.flash_kernel import pallas_block_sparse_attention
+
+    b, s, hq, hkv, d, blk = 1, 512, 4, 2, 64, 128
+    n = s // blk
+    layout = np.zeros((n, n), bool)
+    for i in range(n):
+        layout[i, max(0, i - 1) : i + 1] = True  # window of 2 blocks
+    q, k, v = _sparse_qkv(b, s, hq, hkv, d)
+
+    elem = jnp.repeat(jnp.repeat(jnp.asarray(layout), blk, 0), blk, 1)
+
+    def ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=True, attn_mask=elem)
+
+    def sp(q, k, v):
+        return pallas_block_sparse_attention(q, k, v, layout, blk, causal=True)
+
+    np.testing.assert_allclose(
+        np.asarray(sp(q, k, v)), np.asarray(ref(q, k, v)), atol=2e-5
+    )
+    gs = jax.grad(lambda *a: jnp.sum(sp(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=1e-3)
+
+
+def test_block_sparse_kernel_grid_scales_with_sparsity():
+    """The compute-skipping contract: the sparse kernel's grid is
+    (heads, n_q, max_active) — at ~75% block sparsity it must be at least
+    2x smaller than the dense kernel's (heads, n_q, n_k) grid."""
+    from deepspeed_tpu.ops.pallas.flash_kernel import _sparse_tables
+
+    s, blk = 2048, 128
+    n = s // blk  # 16
+    layout = np.zeros((n, n), bool)
+    for i in range(n):
+        layout[i, max(0, i - 3) : i + 1] = True  # 4-block window = 75% sparse
+    tbl, counts, tblT, countsT = _sparse_tables(layout, causal=True)
+    max_a = len(tbl[0])
+    dense_grid = n * n
+    sparse_grid = n * max_a
+    assert dense_grid / sparse_grid >= 2.0, (dense_grid, sparse_grid)
+    # and the work actually done (sum of counts) reflects the sparsity
+    assert sum(counts) <= 0.3 * dense_grid
+
+
+def test_block_sparse_kernel_wall_clock_beats_dense():
+    """Interpret-mode wall clock at 75% block sparsity: >= 2x over the dense
+    flash kernel on the same shapes (the reference's ~6x axis at its scale,
+    docs/_pages/training.md:108)."""
+    import time
+
+    from deepspeed_tpu.ops.pallas.flash_kernel import (
+        pallas_block_sparse_attention,
+        pallas_flash_attention,
+        set_block_sizes,
+    )
+
+    b, s, hq, hkv, d, blk = 1, 2048, 2, 2, 64, 128
+    n = s // blk
+    layout = np.zeros((n, n), bool)
+    for i in range(n):
+        layout[i, max(0, i - 3) : i + 1] = True
+    q, k, v = _sparse_qkv(b, s, hq, hkv, d, seed=11)
+
+    set_block_sizes(blk, blk)  # same tile for a fair grid comparison
+    try:
+        sp = jax.jit(lambda q, k, v: pallas_block_sparse_attention(
+            q, k, v, layout, blk, causal=True))
+        dn = jax.jit(lambda q, k, v: pallas_flash_attention(q, k, v, causal=True))
+        sp(q, k, v).block_until_ready()
+        dn(q, k, v).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(5):
+            sp(q, k, v).block_until_ready()
+        t_sparse = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(5):
+            dn(q, k, v).block_until_ready()
+        t_dense = time.perf_counter() - t0
+    finally:
+        set_block_sizes(None, None)
+    # the deterministic >=2x contract is test_block_sparse_kernel_grid_scales
+    # _with_sparsity; wall clock gets slack for loaded CI machines (measured
+    # 1.71x/3.18x on a real v5e at 78%/91% sparsity — README)
+    assert t_dense / t_sparse >= 1.4, (t_dense, t_sparse)
+
+
+def test_block_sparse_dispatcher_uses_kernel():
+    """ops.sparse_attention.block_sparse_attention routes to the Pallas
+    kernel when the layout block is kernel-viable."""
+    from deepspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig,
+        block_sparse_attention,
+    )
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+
+    calls = {}
+    orig = fk.pallas_block_sparse_attention
+
+    def spy(*a, **kw):
+        calls["hit"] = True
+        return orig(*a, **kw)
+
+    fk.pallas_block_sparse_attention = spy
+    try:
+        b, s, d = 1, 512, 64
+        q, k, v = _sparse_qkv(b, s, 2, 2, d, seed=13)
+        cfg = FixedSparsityConfig(block=128, num_local_blocks=2, num_global_blocks=0)
+        out = block_sparse_attention(q, k, v, cfg, causal=True)
+        assert calls.get("hit"), "kernel path not taken"
+        # tiny-block config falls back to the masked dense body
+        calls.clear()
+        cfg16 = FixedSparsityConfig(block=16, num_local_blocks=2, num_global_blocks=0)
+        block_sparse_attention(q, k, v, cfg16, causal=True)
+        assert not calls.get("hit")
+    finally:
+        fk.pallas_block_sparse_attention = orig
