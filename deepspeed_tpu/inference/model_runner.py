@@ -24,7 +24,7 @@ from ..models.transformer import (
     norm,
     rope,
 )
-from ..ops.attention import dot_product_attention
+from ..ops.pallas.flash_attention import flash_attention
 from .paged import paged_attention_decode, write_decode_kv, write_prefill_kv
 
 Params = Any
@@ -89,7 +89,10 @@ def prefill(
         new_cv = new_cv.at[l].set(
             write_prefill_kv(new_cv[l], v[0].astype(new_cv.dtype), blocks, length)
         )
-        attn = dot_product_attention(
+        # dispatcher: Pallas flash kernel on TPU when the shape qualifies
+        # (prompt >= 128, tile-divisible), else the fused XLA body — serving
+        # prefill is exactly where the kernel's MXU efficiency pays
+        attn = flash_attention(
             q, k, v, causal=True, logits_soft_cap=cfg.logits_soft_cap
         )
         attn = attn.reshape(1, s, -1) @ lw["attn"]["wo"]
@@ -149,8 +152,10 @@ def prefill_packed(
             v[0].astype(new_cv.dtype), mode="drop"
         )
         # packed order == position order within each segment, so causal
-        # masking by buffer index + segment masking is exact
-        attn = dot_product_attention(
+        # masking by buffer index + segment masking is exact.  The flash
+        # kernel handles packed segments natively (per-block int32 tiles),
+        # so SplitFuse prefill runs on the MXU-tiled path on TPU
+        attn = flash_attention(
             q, k, v, causal=True, segment_ids=seg,
             logits_soft_cap=cfg.logits_soft_cap,
         )

@@ -743,7 +743,9 @@ def sparse_supports(q, k, v, layout_block: int, causal: bool, q_offset,
     _, sk, hk, _ = k.shape
     if sq != sk:
         return False
-    if layout_block < 128 or sq % layout_block:
+    # 1024 is the v5e VMEM ceiling (_pick_block): larger tiles fail to
+    # compile on hardware, so oversized layouts take the dense fallback
+    if layout_block < 128 or layout_block > 1024 or sq % layout_block:
         return False
     if d not in (64, 128, 256):
         return False

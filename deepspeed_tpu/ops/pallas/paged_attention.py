@@ -45,7 +45,14 @@ def supports(q, cache_k, logits_soft_cap) -> bool:
     nb, bs, hkv, _ = cache_k.shape
     if logits_soft_cap is not None:
         return False
-    if hd % 8 or hd < 8:
+    # Mosaic requires the per-page DMA slice's minor dim aligned to the
+    # (2,128) tiling on hardware: hd=64 fails with "Slice shape along
+    # dimension 3 must be aligned to tiling (128)".  Interpret mode (CPU
+    # tests) has no such constraint.
+    if _INTERPRET:
+        if hd % 8 or hd < 8:
+            return False
+    elif hd % 128:
         return False
     if hq % hkv:
         return False

@@ -253,6 +253,7 @@ def test_block_sparse_kernel_grid_scales_with_sparsity():
     assert sum(counts) <= 0.3 * dense_grid
 
 
+@pytest.mark.perf
 def test_block_sparse_kernel_wall_clock_beats_dense():
     """Interpret-mode wall clock at 75% block sparsity: >= 2x over the dense
     flash kernel on the same shapes (the reference's ~6x axis at its scale,

@@ -150,3 +150,77 @@ def test_v2_admission_control(tiny_model):
     assert not v2.can_schedule([4])  # no free slots (max_seqs=1)
     v2.flush([1])
     assert v2.can_schedule([8])
+
+
+# ---------------------------------------------------------------------------
+# r4: serving prefill runs the Pallas flash kernel (VERDICT r3 #6)
+# ---------------------------------------------------------------------------
+def test_packed_prefill_dispatches_flash_kernel(monkeypatch):
+    """With the kernel backend 'available' (forced + interpret mode), a
+    kernel-sized packed prefill must run pallas_flash_attention — with
+    generation identical to the dense-body path."""
+    import deepspeed_tpu.ops.pallas.flash_attention as fa
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+
+    cfg = get_preset("tiny", num_layers=2, max_seq_len=256).replace(
+        head_dim=64, dtype=jnp.float32
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    prompt = list(range(3, 150))  # 147 tokens -> 256 bucket, kernel-sized
+
+    def run():
+        eng = InferenceEngineV2(params, cfg, max_seqs=4, num_blocks=64,
+                                block_size=16)
+        out = eng.put([1], [prompt], SamplingParams(temperature=0.0))
+        for _ in range(3):
+            step = eng.step(SamplingParams(temperature=0.0))
+        return eng.mgr.seqs[1].tokens[len(prompt):]
+
+    dense_toks = run()
+
+    calls = {}
+    orig = fk.pallas_flash_attention
+    fk.set_interpret(True)
+    monkeypatch.setattr(fa, "is_compatible", lambda: True)
+
+    def spy(*a, **kw):
+        calls["hit"] = calls.get("hit", 0) + 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fk, "pallas_flash_attention", spy)
+    try:
+        kernel_toks = run()
+    finally:
+        fk.set_interpret(False)
+    assert calls.get("hit", 0) >= 1, "prefill did not dispatch the kernel"
+    assert kernel_toks == dense_toks, (kernel_toks, dense_toks)
+
+
+def test_small_bucket_prefill_falls_back_dense(monkeypatch):
+    """64-token buckets are below the kernel's 128 minimum: dispatcher must
+    fall back (no crash, no kernel call)."""
+    import deepspeed_tpu.ops.pallas.flash_attention as fa
+    from deepspeed_tpu.ops.pallas import flash_kernel as fk
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+
+    cfg = get_preset("tiny", num_layers=2, max_seq_len=256).replace(
+        head_dim=64, dtype=jnp.float32
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    calls = {}
+    monkeypatch.setattr(fa, "is_compatible", lambda: True)
+    monkeypatch.setattr(
+        fk, "pallas_flash_attention",
+        lambda *a, **kw: calls.setdefault("hit", True),
+    )
+    eng = InferenceEngineV2(params, cfg, max_seqs=4, num_blocks=64,
+                            block_size=16)
+    out = eng.put([1], [[5, 6, 7, 8]], SamplingParams(temperature=0.0))
+    assert 1 in out and not calls.get("hit")
