@@ -1,5 +1,6 @@
 """Elasticity: batch-size math for restart-at-any-scale (reference
 deepspeed/elasticity/)."""
+from .elastic_agent import ElasticAgent  # noqa: F401
 from .elasticity import (  # noqa: F401
     ElasticityConfig,
     ElasticityConfigError,
