@@ -73,6 +73,11 @@ class ZeroConfig:
     zero_quantized_gradients: bool = False
     # hpZ: secondary partition size (hierarchical gather group)
     zero_hpz_partition_size: int = 1
+    # NVMe offload pipelining (reference offload_config.py:78
+    # pipeline_read/pipeline_write -> pipeline): overlap step k's host Adam
+    # walk with step k+1's device grad computation (ZeRO-Offload's delayed
+    # parameter update — one-step gradient staleness)
+    offload_pipeline: bool = False
     # legacy keys accepted & ignored for compat with reference configs
     allgather_partitions: bool = True
     overlap_comm: bool = True
@@ -96,6 +101,11 @@ class ZeroConfig:
             if isinstance(v, dict):  # reference nests {"device": "cpu", ...}
                 if v.get("nvme_path"):
                     self.offload_nvme_path = v["nvme_path"]
+                if k == "offload_optimizer" and (
+                    v.get("pipeline") or v.get("pipeline_read")
+                    or v.get("pipeline_write")
+                ):
+                    self.offload_pipeline = True
                 setattr(self, k, v.get("device"))
         if self.offload_optimizer not in (None, "none", "cpu", "nvme"):
             raise ConfigError(f"bad offload_optimizer {self.offload_optimizer}")

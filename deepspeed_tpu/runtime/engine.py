@@ -59,6 +59,12 @@ from . import precision, zero
 from .lr_schedules import LRScheduler, get_lr_schedule_fn
 
 
+def _now() -> float:
+    import time
+
+    return time.perf_counter()
+
+
 class TrainState(NamedTuple):
     """All mutable training state, as one pytree carried through jit."""
 
@@ -655,6 +661,19 @@ class DeepSpeedTpuEngine:
         # state.params holds the bf16 compute copy; masters are on disk
         self.master_shardings = self.param_shardings
         self.master_shardings_dev = self.param_shardings
+        self._nvme_pending = None
+        self._nvme_walk_span = None
+        self._nvme_timeline: list = []
+        if zcfg.offload_pipeline:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # ONE worker: walks are strictly ordered (step k joins before
+            # step k+1 dispatches)
+            self._nvme_executor = ThreadPoolExecutor(max_workers=1)
+            log_dist(
+                "nvme offload: pipelined (delayed parameter update — the "
+                "host Adam walk overlaps the next step's grad computation)"
+            )
         self.opt_shardings = ()
         self.opt_shardings_dev = ()
         return compute_params, ()
@@ -721,22 +740,10 @@ class DeepSpeedTpuEngine:
         # size, on the path that exists because memory is tight
         master_sh = jax.tree_util.tree_leaves(self.master_shardings_dev)
 
-        def call(state: TrainState, batch_, rng):
-            loss, grads, gnorm = jit_grad(state.params, batch_, rng, state.step)
-            # start every grad leaf's D2H copy before blocking on the norm:
-            # transfers run while we wait and while early leaves update
-            for leaf in jax.tree_util.tree_leaves(grads):
-                try:
-                    leaf.copy_to_host_async()
-                except AttributeError:
-                    pass
-            gn = float(gnorm)
-            coef = min(1.0, clip / (gn + 1e-6)) if clip and clip > 0 else 1.0
-            lr = float(self.lr_schedule_fn(state.step))
-            step_num = int(state.step) + 1
-            # per-leaf H2D uploads begin the moment each master is updated,
-            # overlapping the remaining host Adam walk (reference
-            # pipelined_optimizer_swapper overlap, weak #7)
+        def host_walk(grads, lr, step_num, coef):
+            """Step k's host side: disk IO + fused Adam + per-leaf H2D
+            uploads (which begin the moment each master updates, overlapping
+            the remaining walk).  Returns the bf16 compute params."""
             device_masters: list = [None] * self._nvme_opt.num_leaves
 
             def on_leaf(i, master):
@@ -746,9 +753,42 @@ class DeepSpeedTpuEngine:
             masters = jax.tree_util.tree_unflatten(
                 self._nvme_opt.treedef, device_masters
             )
+            return upload(masters)
+
+        def call(state: TrainState, batch_, rng):
+            pipelined = self.config.zero_optimization.offload_pipeline
+            # ZeRO-Offload delayed parameter update: DISPATCH this step's
+            # grads (async) against the params we already have — one walk
+            # stale — so the device computes them while the host joins step
+            # k-1's background Adam walk below.  Join-before-dispatch would
+            # serialize the pipeline.
+            loss, grads, gnorm = jit_grad(state.params, batch_, rng, state.step)
+            if pipelined:
+                self._nvme_timeline.append(("dispatch", _now()))
+            # start every grad leaf's D2H copy before blocking on the norm:
+            # transfers run while we wait and while early leaves update
+            for leaf in jax.tree_util.tree_leaves(grads):
+                try:
+                    leaf.copy_to_host_async()
+                except AttributeError:
+                    pass
+            joined = self._join_nvme_walk()  # host blocks; device is busy
+            gn = float(gnorm)
+            coef = min(1.0, clip / (gn + 1e-6)) if clip and clip > 0 else 1.0
+            lr = float(self.lr_schedule_fn(state.step))
+            step_num = int(state.step) + 1
+            if pipelined:
+                self._nvme_pending = self._nvme_executor.submit(
+                    self._timed_walk, host_walk, grads, lr, step_num, coef
+                )
+                # params advance by the JOINED walk (step k-1); this step's
+                # walk lands at the next call/flush — one-step staleness
+                new_params = joined if joined is not None else state.params
+            else:
+                new_params = host_walk(grads, lr, step_num, coef)
             new_state = TrainState(
                 step=state.step + 1,
-                params=upload(masters),
+                params=new_params,
                 opt_state=state.opt_state,
                 loss_scale=state.loss_scale,
             )
@@ -762,6 +802,32 @@ class DeepSpeedTpuEngine:
             return new_state, metrics
 
         return call
+
+    def _timed_walk(self, host_walk, grads, lr, step_num, coef):
+        self._nvme_timeline.append(("walk_start", _now()))
+        params = host_walk(grads, lr, step_num, coef)
+        self._nvme_timeline.append(("walk_end", _now()))
+        self._nvme_walk_span = (
+            self._nvme_timeline[-2][1], self._nvme_timeline[-1][1]
+        )
+        return params
+
+    def _join_nvme_walk(self):
+        """Adopt the pending background walk's params (pipelined NVMe mode);
+        None when nothing is pending."""
+        pending = getattr(self, "_nvme_pending", None)
+        if pending is None:
+            return None
+        self._nvme_pending = None
+        return pending.result()
+
+    def flush_nvme_pipeline(self) -> None:
+        """Complete any in-flight host Adam walk and adopt its params —
+        called before checkpoint save/load and eval so the visible state is
+        exact (and no worker thread races the swap files)."""
+        params = self._join_nvme_walk()
+        if params is not None:
+            self.state = self.state._replace(params=params)
 
     # ------------------------------------------------------------------
     # public API — fused path
@@ -967,6 +1033,7 @@ class DeepSpeedTpuEngine:
     # eval / inference
     # ------------------------------------------------------------------
     def eval_batch(self, batch):
+        self.flush_nvme_pipeline()
         if self._eval_step is None:
             fn = self.eval_fn or self.loss_fn
 
@@ -1012,6 +1079,7 @@ class DeepSpeedTpuEngine:
 
     def module_params(self):
         """Compute-dtype view of the current parameters."""
+        self.flush_nvme_pipeline()  # pipelined NVMe: adopt the latest walk
         return precision.cast_floating(self.state.params, self.compute_dtype)
 
     def _emit_monitor(self, metrics: StepMetrics):
@@ -1038,9 +1106,15 @@ class DeepSpeedTpuEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         from ..checkpoint.saving import save_checkpoint as _save
 
+        self.flush_nvme_pipeline()
+
         return _save(self, save_dir, tag=tag, client_state=client_state or {})
 
     def load_checkpoint(self, load_dir, tag=None, **kw):
         from ..checkpoint.saving import load_checkpoint as _load
+
+        # a pending walk would race the swap files being restored AND its
+        # result would clobber the loaded params at the next join
+        self.flush_nvme_pipeline()
 
         return _load(self, load_dir, tag=tag, **kw)

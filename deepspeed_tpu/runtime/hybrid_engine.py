@@ -76,6 +76,9 @@ class DeepSpeedHybridEngine:
     def _serving_params(self):
         """Live training params -> compute-dtype serving tree (LoRA merged —
         the reference's fuse_lora before generate)."""
+        flush = getattr(self.engine, "flush_nvme_pipeline", None)
+        if flush is not None:
+            flush()  # pipelined NVMe: serve post-update weights
         params = self.engine.state.params
         dtype = self._infer_cfg.dtype
 
