@@ -153,6 +153,27 @@ def initialize(
             f"block={sp.block}"
         )
 
+    if cfg.tensor_parallel.domino_chunks > 1:
+        if model is None or not hasattr(model, "cfg"):
+            raise ConfigError(
+                "tensor_parallel.domino_chunks requires model= (a "
+                "models.CausalLM); it cannot chunk a raw loss_fn"
+            )
+        if getattr(model.cfg, "moe_num_experts", 0) > 0:
+            raise ConfigError(
+                "domino_chunks does not compose with MoE: capacity-based "
+                "routing per chunk would change token dropping vs the "
+                "full-batch build (not an overlap-only transformation)"
+            )
+        _set_model_cfg(
+            model,
+            model.cfg.replace(domino_chunks=cfg.tensor_parallel.domino_chunks),
+        )
+        log_dist(
+            f"domino TP overlap: {cfg.tensor_parallel.domino_chunks} "
+            "chunks per layer"
+        )
+
     if cfg.progressive_layer_drop.enabled:
         if model is None or not hasattr(model, "cfg"):
             raise ConfigError(

@@ -118,6 +118,12 @@ class ZeroConfig:
             self.offload_optimizer = None
         if self.offload_param == "none":
             self.offload_param = None
+        if self.offload_pipeline and self.offload_optimizer != "nvme":
+            raise ConfigError(
+                "offload_optimizer pipeline/pipeline_read/pipeline_write is "
+                "implemented for device='nvme' only (the CPU tier's step is "
+                "a single fused jit with nothing to overlap)"
+            )
 
 
 @dataclass
@@ -226,6 +232,17 @@ class MoEConfig:
 class TensorParallelConfig:
     enabled: bool = False
     tp_size: int = 1
+    # Domino-style micro-chunked TP overlap (reference runtime/domino):
+    # batch chunks per layer whose independent dataflows let XLA overlap
+    # TP all-reduces with compute; 1 = off
+    domino_chunks: int = 1
+
+    def __post_init__(self):
+        if self.domino_chunks < 1:
+            raise ConfigError(
+                f"tensor_parallel.domino_chunks must be >= 1, got "
+                f"{self.domino_chunks}"
+            )
 
 
 @dataclass

@@ -83,6 +83,11 @@ class TransformerConfig:
     # block-sparse attention layout (ops/sparse_attention.SparsityConfig);
     # wired from the config's sparse_attention section by initialize()
     sparse_attention: Optional[Any] = None
+    # Domino-style TP overlap (reference runtime/domino): split the batch
+    # into this many independent chunks inside the layer-scan body so XLA
+    # can overlap one chunk's TP all-reduce with another's compute; 1 = off.
+    # Wired from config tensor_parallel.domino_chunks by initialize().
+    domino_chunks: int = 1
 
     @property
     def hd(self) -> int:
@@ -397,11 +402,48 @@ def forward(
         )
         new_caches = None
     else:
+        # Domino-style TP overlap (reference runtime/domino/transformer.py:18):
+        # split the batch into C independent chunks INSIDE the layer-scan
+        # body.  Each chunk's ops form an independent dataflow, so XLA's
+        # latency-hiding scheduler can run chunk B's matmuls while chunk A's
+        # row-parallel activation all-reduce rides the ICI — the overlap a
+        # single-chunk body cannot offer (the allreduce sits on the one
+        # critical path; measured sync in the TP=8 HLO, README).  Chunking
+        # at the top of the scan (not two scans) matters: while loops are
+        # scheduling barriers, one loop body is not.
+        C = cfg.domino_chunks if cache is None else 1
+        if C > 1 and cfg.moe_num_experts > 0:
+            raise ValueError(
+                "domino_chunks does not compose with MoE (per-chunk routing "
+                "capacity changes token dropping)"
+            )
+        if C > 1 and b % C:
+            C = 1  # indivisible batch: fall back to the single-chunk body
+
         def body(carry, scanned):
             h = carry
             lw, layer_cache, keep = scanned
 
             def run_layer(h):
+                if C > 1:
+                    outs = []
+                    auxs = []
+                    bc = b // C
+                    for c in range(C):
+                        sl = slice(c * bc, (c + 1) * bc)
+                        h_c, _, aux_c = decoder_layer(
+                            lw, h[sl], cfg, positions[sl], attn_fn,
+                            segment_ids[sl] if segment_ids is not None else None,
+                            None, None,
+                        )
+                        outs.append(h_c)
+                        auxs.append(aux_c)
+                    # per-chunk aux are means over their rows; equal-size
+                    # chunks -> plain mean preserves the dense semantics
+                    return (
+                        jnp.concatenate(outs, axis=0), None,
+                        jnp.mean(jnp.stack(auxs)),
+                    )
                 return decoder_layer(
                     lw, h, cfg, positions, attn_fn, segment_ids, layer_cache,
                     cache_index,

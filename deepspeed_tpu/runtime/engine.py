@@ -663,7 +663,10 @@ class DeepSpeedTpuEngine:
         self.master_shardings_dev = self.param_shardings
         self._nvme_pending = None
         self._nvme_walk_span = None
-        self._nvme_timeline: list = []
+        # bounded: instrumentation for tests/diagnostics, not a step log
+        from collections import deque
+
+        self._nvme_timeline: "deque" = deque(maxlen=512)
         if zcfg.offload_pipeline:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -804,12 +807,14 @@ class DeepSpeedTpuEngine:
         return call
 
     def _timed_walk(self, host_walk, grads, lr, step_num, coef):
-        self._nvme_timeline.append(("walk_start", _now()))
+        t0 = _now()
+        self._nvme_timeline.append(("walk_start", t0))
         params = host_walk(grads, lr, step_num, coef)
-        self._nvme_timeline.append(("walk_end", _now()))
-        self._nvme_walk_span = (
-            self._nvme_timeline[-2][1], self._nvme_timeline[-1][1]
-        )
+        t1 = _now()
+        self._nvme_timeline.append(("walk_end", t1))
+        # locals, not timeline[-2:]: the main thread appends 'dispatch'
+        # entries to the shared deque concurrently
+        self._nvme_walk_span = (t0, t1)
         return params
 
     def _join_nvme_walk(self):

@@ -39,3 +39,15 @@ def make_grid(**axes):
 @pytest.fixture
 def grid8():
     return make_grid(fsdp=8)
+
+
+@pytest.fixture(autouse=True)
+def _clear_ambient_mesh():
+    """initialize() installs the mesh as ambient state (by design, for user
+    flows); tests must not leak it into each other — an AOT-topology test
+    running after an engine test would otherwise constrain against the
+    previous test's CPU mesh."""
+    yield
+    from deepspeed_tpu.parallel.sharding import set_current_mesh
+
+    set_current_mesh(None)

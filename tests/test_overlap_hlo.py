@@ -198,3 +198,52 @@ def test_ring_attention_permutes_overlap_compute():
     assert overlapped >= 1, (
         "no collective-permute start/done pair had compute scheduled between"
     )
+
+
+def test_domino_chunks_create_overlappable_tp_collectives():
+    """Single-chunk TP layers leave their activation all-reduces synchronous
+    on the one critical path (the measured baseline).  With
+    domino_chunks=2 the per-chunk dataflows are independent, so the
+    scheduler must async at least some of the per-layer collectives —
+    strictly more async starts than the single-chunk build."""
+    import functools
+
+    from deepspeed_tpu.config.config import ZeroConfig
+    from deepspeed_tpu.models import CausalLM, get_preset
+    from deepspeed_tpu.models.transformer import init_params, tp_rules
+    from deepspeed_tpu.parallel.topology import MeshSpec, build_mesh
+    from deepspeed_tpu.runtime.zero import plan_sharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = MeshSpec(model=8)
+    mesh = build_mesh(spec, devices=_TOPO.devices)
+
+    def compile_counts(domino):
+        cfg = get_preset("tiny", num_layers=8).replace(domino_chunks=domino)
+        model = CausalLM(cfg)
+        shapes = jax.eval_shape(
+            functools.partial(init_params, cfg=cfg, dtype=jnp.bfloat16),
+            jax.random.PRNGKey(0),
+        )
+        plan = plan_sharding(shapes, ZeroConfig(stage=0), spec, tp_rules=tp_rules(cfg))
+        param_sh = plan.param_shardings(mesh)
+
+        def loss(params, tokens):
+            return model.loss_fn(params, {"input_ids": tokens})
+
+        params_s = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16, sharding=sh),
+            shapes, param_sh,
+        )
+        tok_s = jax.ShapeDtypeStruct(
+            (8, 256), jnp.int32, sharding=NamedSharding(mesh, P(None, None)),
+        )
+        txt = jax.jit(jax.grad(loss)).lower(params_s, tok_s).compile().as_text()
+        return {
+            "async": txt.count("AsyncCollectiveStart"),
+            "sync_ar": txt.count(" all-reduce("),
+        }
+
+    base = compile_counts(1)
+    chunked = compile_counts(2)
+    assert chunked["async"] > base["async"], (base, chunked)
