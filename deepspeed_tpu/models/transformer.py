@@ -374,9 +374,9 @@ def forward(
 
     The L layers run as one ``lax.scan`` over the stacked layer params; the
     scanned body is optionally wrapped in ``jax.checkpoint`` per ``cfg.remat``.
-    ``stack_apply(layer_params, x, positions) -> x`` overrides the decoder
-    stack execution (the pipeline-parallel executor hooks in here); caches
-    and MoE aux losses are unsupported on that path.
+    ``stack_apply(layer_params, x, positions, segment_ids) -> x`` overrides
+    the decoder stack execution (the pipeline-parallel executor hooks in
+    here); caches are unsupported on that path.
     """
     attn_fn = _get_attn_fn(cfg)
     b, s = tokens.shape
@@ -395,7 +395,7 @@ def forward(
                 "layer_keep (progressive layer drop) is not supported on the "
                 "stack_apply/pipelined path"
             )
-        out = stack_apply(params["layers"], x, positions)
+        out = stack_apply(params["layers"], x, positions, segment_ids)
         # pipelined stacks return (x, moe_aux_loss); plain ones just x
         x, aux_loss = out if isinstance(out, tuple) else (
             out, jnp.asarray(0.0, jnp.float32)

@@ -56,12 +56,16 @@ def pipeline_apply(
     num_micro: int,
     mesh=None,
     with_aux: bool = False,
+    extra: Any = None,
 ):
     """Run a stacked layer pytree (leading dim L, L % num_stages == 0) over
     activations ``x`` [B, ...] split into ``num_micro`` microbatches.
 
     ``layer_fn(x_mb, one_layer_params) -> x_mb`` (or ``(x_mb, aux_scalar)``
     when ``with_aux`` — MoE load-balancing losses) applies a single layer.
+    ``extra`` ([B, ...], e.g. packed-sequence segment ids) rides along
+    un-transformed: each stage indexes its CURRENT microbatch's rows and
+    passes them as ``layer_fn(x_mb, lw, extra_mb)``; no gradient flows to it.
     Returns activations [B, ...] (plus the summed aux scalar when
     ``with_aux``) after all L layers.
 
@@ -90,6 +94,11 @@ def pipeline_apply(
         raise ValueError(f"batch {B} not divisible by {num_micro} microbatches")
     mb = B // num_micro
     xm = x.reshape((num_micro, mb) + x.shape[1:])
+    has_extra = extra is not None
+    if not has_extra:
+        # dummy rider keeps one code path; int32 so the cotangent is float0
+        extra = jnp.zeros((B, 1), jnp.int32)
+    em = extra.reshape((num_micro, mb) + extra.shape[1:])
     T = num_micro + num_stages - 1
 
     from ...parallel.topology import DATA_AXIS, FSDP_AXIS, SUB_AXIS
@@ -117,14 +126,14 @@ def pipeline_apply(
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_rev = [(i, (i - 1) % S) for i in range(S)]
 
-    def apply_stage(local_layers, h):
+    def apply_stage(local_layers, h, ex):
         def one(carry, lw):
             h, aux = carry
             # no explicit sharding constraints inside the manual region
             # (they crash XLA's backward partitioner); GSPMD still
             # propagates TP layouts from the weights
             with mesh_disabled():
-                out = layer_fn(h, lw)
+                out = layer_fn(h, lw, ex) if has_extra else layer_fn(h, lw)
             if with_aux:
                 h, a = out
                 aux = aux + a
@@ -137,7 +146,7 @@ def pipeline_apply(
         )
         return h, aux
 
-    def fwd_body(local_layers, x_all):
+    def fwd_body(local_layers, x_all, e_all):
         sid = lax.axis_index(STAGE_AXIS)
         is_first = sid == 0
         is_last = sid == S - 1
@@ -149,7 +158,12 @@ def pipeline_apply(
             )
             take = jnp.logical_and(is_first, t < M)
             buf = jnp.where(take, inject, buf)
-            buf, aux = apply_stage(local_layers, buf)
+            # the rider for the microbatch RESIDENT on this stage (t - sid);
+            # bubble ticks index garbage that validity gating discards
+            ex = lax.dynamic_index_in_dim(
+                e_all, jnp.clip(t - sid, 0, M - 1), axis=0, keepdims=False
+            )
+            buf, aux = apply_stage(local_layers, buf, ex)
             # stage s holds microbatch t - s at tick t; outside [0, M) the
             # buffer is bubble garbage — gate aux on validity
             micro_here = t - sid
@@ -198,7 +212,7 @@ def pipeline_apply(
     K = max(1, 2 * S - 1)
     U = M + 2 * (S - 1)
 
-    def bwd_body(local_layers, x_all, ybar, auxbar):
+    def bwd_body(local_layers, x_all, e_all, ybar, auxbar):
         sid = lax.axis_index(STAGE_AXIS)
         is_first = sid == 0
         is_last = sid == S - 1
@@ -215,7 +229,10 @@ def pipeline_apply(
             take = jnp.logical_and(is_first, u < M)
             buf = jnp.where(take, inject, buf)
             fifo = lax.dynamic_update_index_in_dim(fifo, buf, u % K, axis=0)
-            fout, _ = apply_stage(local_layers, buf)
+            ex_wave = lax.dynamic_index_in_dim(
+                e_all, jnp.clip(u - sid, 0, M - 1), axis=0, keepdims=False
+            )
+            fout, _ = apply_stage(local_layers, buf, ex_wave)
             # ---- backward chase ----
             m_b = u - 2 * (S - 1) + sid
             valid_b = jnp.logical_and(m_b >= 0, m_b < M)
@@ -225,7 +242,12 @@ def pipeline_apply(
                 ybar, jnp.clip(m_b, 0, M - 1), axis=0, keepdims=False
             )
             yb = jnp.where(is_last, yrow, gbuf)
-            _, vjp_fn = jax.vjp(apply_stage, local_layers, x_in)
+            ex_b = lax.dynamic_index_in_dim(
+                e_all, jnp.clip(m_b, 0, M - 1), axis=0, keepdims=False
+            )
+            _, vjp_fn = jax.vjp(
+                lambda lw, h: apply_stage(lw, h, ex_b), local_layers, x_in
+            )
             lw_bar, x_bar = vjp_fn(
                 (yb, jnp.where(valid_b, aux_ct, jnp.zeros_like(aux_ct)))
             )
@@ -266,6 +288,7 @@ def pipeline_apply(
         return wgrad, xbar
 
     x_spec = P(*((None, batch_entry) + (None,) * (x.ndim - 1)))
+    e_spec = P(*((None, batch_entry) + (None,) * (em.ndim - 2)))
     out_spec = (P(*((None, batch_entry) + (None,) * (x.ndim - 1))), P())
     layer_specs = jax.tree_util.tree_map(
         lambda leaf: P(*((STAGE_AXIS,) + (None,) * (leaf.ndim - 1))), layer_params
@@ -274,34 +297,42 @@ def pipeline_apply(
     fwd_sm = jax.shard_map(
         fwd_body,
         mesh=mesh,
-        in_specs=(layer_specs, x_spec),
+        in_specs=(layer_specs, x_spec, e_spec),
         out_specs=out_spec,
         check_vma=False,
     )
     bwd_sm = jax.shard_map(
         bwd_body,
         mesh=mesh,
-        in_specs=(layer_specs, x_spec, x_spec, P()),
+        in_specs=(layer_specs, x_spec, e_spec, x_spec, P()),
         out_specs=(layer_specs, x_spec),
         check_vma=False,
     )
 
     @jax.custom_vjp
-    def run(layer_params, xm):
-        return fwd_sm(layer_params, xm)
+    def run(layer_params, xm, em):
+        return fwd_sm(layer_params, xm, em)
 
-    def run_fwd(layer_params, xm):
-        return fwd_sm(layer_params, xm), (layer_params, xm)
+    def run_fwd(layer_params, xm, em):
+        return fwd_sm(layer_params, xm, em), (layer_params, xm, em)
 
     def run_bwd(res, cts):
-        layer_params, xm = res
+        layer_params, xm, em = res
         ybar, auxbar = cts
-        wgrad, xbar = bwd_sm(layer_params, xm, ybar, jnp.asarray(auxbar))
-        return wgrad, xbar
+        wgrad, xbar = bwd_sm(layer_params, xm, em, ybar, jnp.asarray(auxbar))
+        # the rider carries no gradient (segment ids): float0 for ints,
+        # zeros for float riders
+        if jnp.issubdtype(em.dtype, jnp.floating):
+            ebar = jnp.zeros_like(em)
+        else:
+            import numpy as _np
+
+            ebar = _np.zeros(em.shape, jax.dtypes.float0)
+        return wgrad, xbar, ebar
 
     run.defvjp(run_fwd, run_bwd)
 
-    out, aux = run(layer_params, xm)  # [M, mb, ...], scalar
+    out, aux = run(layer_params, xm, em)  # [M, mb, ...], scalar
     out = out.reshape((B,) + x.shape[1:])
     if with_aux:
         return out, aux
@@ -360,12 +391,15 @@ class PipelinedCausalLM:
         rules.append((r"^layers/", P(STAGE_AXIS)))
         return rules
 
-    def _stack_apply(self, layer_params, x, positions):
+    def _stack_apply(self, layer_params, x, positions, segment_ids=None):
         """The hook ``models.transformer.forward`` calls instead of its
         lax.scan — everything else (embed, loss, chunked CE) is the dense
         path, unduplicated.  Returns (x, moe_aux) — MoE blocks compose with
         the pipeline (expert weights run dense-locally per stage shard; the
-        aux loss is validity-gated per tick and psum'd across stages)."""
+        aux loss is validity-gated per tick and psum'd across stages).
+        Packed-sequence ``segment_ids`` ride the pipeline as the per-
+        microbatch ``extra`` input (the reference TrainSchedule is agnostic
+        to packing; so is this executor)."""
         from ...models.transformer import _get_attn_fn, decoder_layer
 
         # the cfg-driven dispatch (sparse layouts included) — NOT the raw
@@ -375,19 +409,21 @@ class PipelinedCausalLM:
         # so the layer body broadcasts over whatever microbatch size it sees
         pos1d = positions[0] if positions.ndim == 2 else positions
 
-        def layer_fn(h, lw):
-            h, _, aux = decoder_layer(lw, h, self.cfg, pos1d, attn_fn)
-            return h, aux
+        if segment_ids is not None:
+            def layer_fn(h, lw, seg):
+                h, _, aux = decoder_layer(
+                    lw, h, self.cfg, pos1d, attn_fn, segment_ids=seg
+                )
+                return h, aux
+        else:
+            def layer_fn(h, lw):
+                h, _, aux = decoder_layer(lw, h, self.cfg, pos1d, attn_fn)
+                return h, aux
 
         return pipeline_apply(
             layer_params, x, layer_fn, self.num_stages, self.num_micro,
-            with_aux=True,
+            with_aux=True, extra=segment_ids,
         )
 
     def loss_fn(self, params, batch, rng=None):
-        if "segment_ids" in batch:
-            raise NotImplementedError(
-                "packed-sequence segment_ids are not supported in the "
-                "pipelined stack (per-microbatch segment routing pending)"
-            )
         return self._inner.loss_fn(params, batch, rng)

@@ -224,3 +224,63 @@ def test_small_bucket_prefill_falls_back_dense(monkeypatch):
                             block_size=16)
     out = eng.put([1], [[5, 6, 7, 8]], SamplingParams(temperature=0.0))
     assert 1 in out and not calls.get("hit")
+
+
+def test_step_n_matches_per_tick_decode():
+    """Pipelined burst decode (tokens stay on device) must produce the same
+    greedy tokens as per-tick step(), including stop-token truncation."""
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+
+    cfg = get_preset("tiny", num_layers=2, max_seq_len=128).replace(
+        dtype=jnp.float32
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    samp = SamplingParams(temperature=0.0)
+    prompts = [[3, 4, 5, 6, 7], [9, 8, 7]]
+
+    def run(use_burst):
+        eng = InferenceEngineV2(params, cfg, max_seqs=4, num_blocks=32,
+                                block_size=16)
+        eng.put([1, 2], prompts, samp)
+        if use_burst:
+            eng.step_n(6, samp)
+        else:
+            for _ in range(6):
+                eng.step(samp)
+        return {u: eng.mgr.seqs[u].tokens[len(p):]
+                for u, p in zip([1, 2], prompts)}
+
+    assert run(False) == run(True)
+
+
+def test_step_n_stop_token_truncates():
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+
+    cfg = get_preset("tiny", num_layers=2, max_seq_len=128).replace(
+        dtype=jnp.float32
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    eng = InferenceEngineV2(params, cfg, max_seqs=4, num_blocks=32,
+                            block_size=16)
+    samp0 = SamplingParams(temperature=0.0)
+    eng.put([1], [[3, 4, 5]], samp0)
+    first_burst = eng.step_n(4, samp0)
+    seq = eng.mgr.seqs[1]
+    # replay with the 3rd generated token as the stop token: the burst must
+    # truncate there and mark the sequence done
+    stop = seq.tokens[3 + 2]  # prompt(3) + first_token + second
+    eng2 = InferenceEngineV2(params, cfg, max_seqs=4, num_blocks=32,
+                             block_size=16)
+    samp = SamplingParams(temperature=0.0, stop_token=int(stop))
+    eng2.put([1], [[3, 4, 5]], samp)
+    eng2.step_n(4, samp)
+    s2 = eng2.mgr.seqs[1]
+    assert s2.done
+    assert s2.tokens[-1] == int(stop)
+    assert len(s2.tokens) <= len(seq.tokens)
