@@ -71,6 +71,9 @@ class ZeroConfig:
     # ZeRO++ style knobs
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
+    # LoCo error-feedback for the quantized gradient reduce (reference
+    # zero/config.py:315 zeropp_loco_param = {"err_beta": 0.8, "reset_T": 1024})
+    zeropp_loco_param: Optional[Dict[str, Any]] = None
     # hpZ: secondary partition size (hierarchical gather group)
     zero_hpz_partition_size: int = 1
     # NVMe offload pipelining (reference offload_config.py:78
@@ -171,6 +174,14 @@ class MonitorSubConfig:
     team: Optional[str] = None
     group: Optional[str] = None
     project: Optional[str] = None
+    # comet extras (reference monitor/config.py CometConfig)
+    api_key: Optional[str] = None
+    workspace: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+    samples_log_interval: int = 100
 
 
 @dataclass
@@ -474,6 +485,7 @@ class Config:
     tensorboard: MonitorSubConfig = field(default_factory=MonitorSubConfig)
     csv_monitor: MonitorSubConfig = field(default_factory=MonitorSubConfig)
     wandb: MonitorSubConfig = field(default_factory=MonitorSubConfig)
+    comet: MonitorSubConfig = field(default_factory=MonitorSubConfig)
     elasticity: Dict[str, Any] = field(default_factory=dict)
     progressive_layer_drop: PLDConfig = field(default_factory=PLDConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
@@ -561,17 +573,16 @@ _REFERENCE_PASSTHROUGH_KEYS = {
     # array dtype; quantized wire formats are the zero++ knobs
     # (zero_quantized_weights/gradients), which ARE consumed
     "communication_data_type",
-    # torch sparse embedding gradients — XLA has no sparse gradient type;
-    # embedding grads are dense psums (SURVEY: documented won't-do)
+    # torch sparse embedding gradients — XLA has no sparse gradient type.
+    # The opt-in TPU equivalent is ops/sparse_grads.py embedding_lookup
+    # (sparse-communication custom VJP under shard_map); models choose it at
+    # construction, not via this runtime flag, so the key stays accepted
     "sparse_gradients",
     # NVIDIA apex mixed precision — bf16/fp16 configs are the path here
     "amp",
     # consumed by the offline autotuner entrypoint (autotuning/autotuner.py),
     # never by the runtime engine — same split as the reference's ds_autotuner
     "autotuning",
-    # monitor backend whose SDK is not in this image; tensorboard/csv/wandb
-    # backends exist (monitor/monitor.py)
-    "comet",
     # pipeline-engine knobs (partition method, activation checkpoint
     # interval) — stage count and partitioning are constructor arguments of
     # PipelinedCausalLM/PipelineModule, chosen with the model, not the JSON

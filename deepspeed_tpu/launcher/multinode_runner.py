@@ -120,6 +120,31 @@ class MPICHRunner(MultiNodeRunner):
         return cmd + list(user_cmd)
 
 
+class IMPIRunner(MultiNodeRunner):
+    """Intel MPI (reference :272 IMPIRunner): hydra ``mpirun`` with per-rank
+    ``-env`` blocks joined by ``:``.  One process per host (the TPU runtime
+    owns every chip in a host), ranks pinned explicitly rather than read
+    from PMI so the command is scheduler-independent; ``I_MPI_PIN=0``
+    mirrors the reference's choice to keep MPI away from core binding."""
+
+    name = "impi"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, user_cmd: List[str]) -> List[str]:
+        cmd = ["mpirun", "-ppn", "1"]
+        for k, v in self._rendezvous_env().items():
+            cmd += ["-genv", k, str(v)]
+        cmd += ["-genv", "I_MPI_PIN", "0"]
+        cmd += ["-hosts", ",".join(self.hosts)]
+        for i in range(self.num_hosts):
+            if i > 0:
+                cmd.append(":")
+            cmd += ["-n", "1", "-env", "DSTPU_PROCESS_ID", str(i)] + list(user_cmd)
+        return cmd
+
+
 class SlurmRunner(MultiNodeRunner):
     """srun (reference :357): ranks from SLURM_PROCID; the host set comes
     from the allocation, so --nodelist is advisory."""
@@ -167,7 +192,11 @@ class MVAPICHRunner(MultiNodeRunner):
 
 
 RUNNERS = {
-    r.name: r for r in (PDSHRunner, OpenMPIRunner, MPICHRunner, SlurmRunner, MVAPICHRunner)
+    r.name: r
+    for r in (
+        PDSHRunner, OpenMPIRunner, MPICHRunner, IMPIRunner, SlurmRunner,
+        MVAPICHRunner,
+    )
 }
 
 

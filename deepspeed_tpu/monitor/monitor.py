@@ -88,6 +88,52 @@ class WandbMonitor(Monitor):
             self._wandb.log({label: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Comet experiment writer (reference ``monitor/comet.py``): lazily
+    starts an experiment, logs metrics by step.  The SDK is optional — when
+    absent the writer disables itself with a warning, same as wandb/TB."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._experiment = None
+        self._interval = max(1, int(getattr(config, "samples_log_interval", 1) or 1))
+        if self.enabled:
+            try:
+                import comet_ml
+
+                kw = {}
+                for attr, key in (
+                    ("api_key", "api_key"),
+                    ("project", "project_name"),
+                    ("workspace", "workspace"),
+                    ("experiment_key", "experiment_key"),
+                    ("online", "online"),
+                    ("mode", "mode"),
+                ):
+                    v = getattr(config, attr, None)
+                    if v is not None:
+                        kw[key] = v
+                self._experiment = comet_ml.start(**kw)
+                name = getattr(config, "experiment_name", None)
+                if name:
+                    self._experiment.set_name(name)
+            except Exception as e:
+                logger.warning(f"comet unavailable ({e}); disabling")
+                self.enabled = False
+
+    @property
+    def experiment(self):
+        return self._experiment
+
+    def write_events(self, events: List[Event]):
+        if self._experiment is None:
+            return
+        for label, value, step in events:
+            # samples_log_interval throttle (reference monitor/comet.py)
+            if step % self._interval == 0:
+                self._experiment.log_metric(label, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """Dispatch to every enabled writer, rank-0 only (monitor/monitor.py:30)."""
 
@@ -101,6 +147,7 @@ class MonitorMaster(Monitor):
                 TensorBoardMonitor(config.tensorboard),
                 CsvMonitor(config.csv_monitor),
                 WandbMonitor(config.wandb),
+                CometMonitor(config.comet),
             ):
                 if w.enabled:
                     self.writers.append(w)

@@ -28,6 +28,7 @@ eager autograd.
 from __future__ import annotations
 
 import functools
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
@@ -147,6 +148,7 @@ class DeepSpeedTpuEngine:
         # ---- ZeRO++ quantized collectives (runtime/zeropp.py) ----
         zcfg = config.zero_optimization
         self._zeropp_vag = None
+        self._loco_state = None  # LoCo error-feedback buffers (zeropp.py)
         if (
             zcfg.stage >= 3
             and (zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients)
@@ -162,6 +164,20 @@ class DeepSpeedTpuEngine:
                 )
             from . import zeropp
 
+            loco = zcfg.zeropp_loco_param
+            if loco is not None and (
+                config.fp16.enabled
+                or zcfg.offload_optimizer is not None
+                or zcfg.offload_param is not None
+            ):
+                from ..config.config import ConfigError
+
+                raise ConfigError(
+                    "zeropp_loco_param requires bf16 and no optimizer/param "
+                    "offload — the error-feedback buffer does not track "
+                    "dynamic loss scales and is not threaded through the "
+                    "offload step wrappers"
+                )
             self._zeropp_vag = zeropp.make_micro_value_and_grad(
                 self.loss_fn,
                 self.mesh,
@@ -169,10 +185,18 @@ class DeepSpeedTpuEngine:
                 self.compute_dtype,
                 zcfg.zero_quantized_weights,
                 zcfg.zero_quantized_gradients,
+                loco_param=loco,
             )
+            if loco is not None:
+                self._loco_state, self._loco_shardings = zeropp.init_loco_state(
+                    self.mesh, shapes, self.plan.master_specs
+                )
+                self._loco_reset_T = int(loco.get("reset_T", 1024))
+                self._loco_calls = 0  # shim-path reset counter
             log_dist(
                 f"ZeRO++ enabled: qwZ={zcfg.zero_quantized_weights} "
-                f"qgZ={zcfg.zero_quantized_gradients} (int8 collectives on fsdp)"
+                f"qgZ={zcfg.zero_quantized_gradients} loco={loco is not None} "
+                f"(int8 collectives on fsdp)"
             )
 
         # ---- offload tiers (reference: runtime/zero/offload_config.py) ----
@@ -268,6 +292,7 @@ class DeepSpeedTpuEngine:
         self._pending: Optional[Dict[str, Any]] = None
         self._grad_buffer = None
         self._micro_steps = 0
+        self._inside_no_sync = False
         self.global_steps = 0
         self.skipped_steps = 0
         self._last_metrics: Optional[StepMetrics] = None
@@ -387,10 +412,19 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # the jitted train step
     # ------------------------------------------------------------------
-    def _micro_value_and_grad(self, master_params, micro_batch, rng, scale, step=None):
+    def _micro_value_and_grad(
+        self, master_params, micro_batch, rng, scale, step=None, loco_err=None
+    ):
         """Loss+grads for one micro-batch, w.r.t. fp32 masters, computed
-        through compute-dtype casts (the BF16_Optimizer linkage, bf16_optimizer.py:34)."""
+        through compute-dtype casts (the BF16_Optimizer linkage, bf16_optimizer.py:34).
+        With LoCo active, also takes/returns the error-feedback pytree:
+        ``(loss, grads, new_err)``."""
         if self._zeropp_vag is not None:
+            if loco_err is not None:
+                loss, grads, new_err = self._zeropp_vag(
+                    master_params, loco_err, micro_batch, rng, scale
+                )
+                return loss / scale, grads, new_err
             loss, grads = self._zeropp_vag(master_params, micro_batch, rng, scale)
             return loss / scale, grads
 
@@ -468,38 +502,50 @@ class DeepSpeedTpuEngine:
         gas = cfg.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
 
-        def train_step(state: TrainState, batch, rng):
+        loco = self._loco_state is not None
+
+        def train_step(state: TrainState, batch, rng, loco_err=None):
             scale = state.loss_scale.scale if fp16 else jnp.asarray(1.0, jnp.float32)
             divisor = scale
+            if loco:
+                # the reference resets the error buffer every reset_T steps
+                # (coalesced_collectives.py:112 loco_idx > reset_T)
+                reset = (state.step % self._loco_reset_T) == 0
+                loco_err = jax.tree_util.tree_map(
+                    lambda e: jnp.where(reset, jnp.zeros_like(e), e), loco_err
+                )
 
-            def one_micro(p, micro, r):
-                loss, grads = self._micro_value_and_grad(p, micro, r, scale, state.step)
+            def one_micro(p, micro, r, err):
+                out = self._micro_value_and_grad(
+                    p, micro, r, scale, state.step, loco_err=err
+                )
+                loss, grads = out[0], out[1]
                 # device-kind layout: grads live in HBM even when masters are
                 # offloaded (only the state pytree itself rides pinned_host)
                 grads = zero.constrain(grads, self.master_shardings_dev)
-                return loss, grads
+                return loss, grads, (out[2] if loco else None)
 
             if gas == 1:
                 micro = jax.tree_util.tree_map(lambda x: x[0], batch)
-                loss, grads = one_micro(state.params, micro, rng)
+                loss, grads, loco_err = one_micro(state.params, micro, rng, loco_err)
             else:
                 # lax.scan over the gas dimension: grads accumulate in fp32 in
                 # the *master* (ZeRO-sharded) layout, so accumulation memory is
                 # already partitioned — the analogue of the reference's
                 # contiguous sharded gradient buffer (stage_1_and_2.py).
                 def body(carry, inp):
-                    acc, lsum = carry
+                    acc, lsum, err = carry
                     micro, r = inp
-                    loss, grads = one_micro(state.params, micro, r)
+                    loss, grads, err = one_micro(state.params, micro, r, err)
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return (acc, lsum + loss), None
+                    return (acc, lsum + loss, err), None
 
                 zeros = jax.tree_util.tree_map(
                     lambda x: jnp.zeros(x.shape, jnp.float32), state.params
                 )
                 rngs = jax.random.split(rng, gas)
-                (grads, loss_sum), _ = jax.lax.scan(
-                    body, (zeros, jnp.asarray(0.0, jnp.float32)), (batch, rngs)
+                (grads, loss_sum, loco_err), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32), loco_err), (batch, rngs)
                 )
                 loss = loss_sum / gas
                 divisor = scale * gas  # fold GAS averaging into the unscale divisor
@@ -514,6 +560,8 @@ class DeepSpeedTpuEngine:
                 loss_scale=scale,
                 skipped=jnp.logical_not(finite),
             )
+            if loco:
+                return new_state, metrics, loco_err
             return new_state, metrics
 
         return train_step
@@ -530,6 +578,31 @@ class DeepSpeedTpuEngine:
             metrics_shardings = StepMetrics(
                 *([self._scalar_sharding] * len(StepMetrics._fields))
             )
+            if self._loco_state is not None:
+                jitted = self._jit(
+                    step_fn,
+                    in_shardings=(
+                        self.state_shardings,
+                        self.batch_sharding(batch, batch_dim=1),
+                        None,
+                        self._loco_shardings,
+                    ),
+                    out_shardings=(
+                        self.state_shardings,
+                        metrics_shardings,
+                        self._loco_shardings,
+                    ),
+                    donate_argnums=(0, 3),
+                )
+
+                def call(state, batch_, rng):
+                    new_state, metrics, self._loco_state = jitted(
+                        state, batch_, rng, self._loco_state
+                    )
+                    return new_state, metrics
+
+                self._train_step = call
+                return self._train_step
             jitted = self._jit(
                 step_fn,
                 in_shardings=(self.state_shardings, self.batch_sharding(batch, batch_dim=1), None),
@@ -887,6 +960,13 @@ class DeepSpeedTpuEngine:
             else None
         )
         self.tput_timer.stop(sync_obj=metrics.loss)
+        if (
+            self.config.memory_breakdown
+            and self.global_steps % self.config.steps_per_print == 0
+        ):
+            from ..utils.memory import see_memory_usage
+
+            see_memory_usage(f"after step {self.global_steps}", force=True)
         self._emit_monitor(metrics)
         if profiling_now:
             # before the wall-clock log below: log(reset=True) zeroes the
@@ -948,26 +1028,56 @@ class DeepSpeedTpuEngine:
             )
         self.timers(FORWARD_GLOBAL_TIMER).start()
         state_sh = self._dev_state_shardings() if self._offload_cpu else self.state_shardings
+        loco = self._loco_state is not None
         if self._grad_fn is None:
-            def micro_step(state, micro, rng):
+            def micro_step(state, micro, rng, loco_err=None):
                 scale = (
                     state.loss_scale.scale
                     if self.config.fp16.enabled
                     else jnp.asarray(1.0, jnp.float32)
                 )
-                loss, grads = self._micro_value_and_grad(
-                    state.params, micro, rng, scale, state.step
+                out = self._micro_value_and_grad(
+                    state.params, micro, rng, scale, state.step, loco_err=loco_err
                 )
+                loss, grads = out[0], out[1]
                 grads = zero.constrain(grads, self.master_shardings_dev)
+                if loco:
+                    return loss, grads, out[2]
                 return loss, grads
 
-            self._grad_fn = self._jit(
-                micro_step,
-                in_shardings=(state_sh, self.batch_sharding(batch), None),
-                out_shardings=(self._scalar_sharding, self.master_shardings_dev),
-            )
+            if loco:
+                self._grad_fn = self._jit(
+                    micro_step,
+                    in_shardings=(
+                        state_sh, self.batch_sharding(batch), None,
+                        self._loco_shardings,
+                    ),
+                    out_shardings=(
+                        self._scalar_sharding, self.master_shardings_dev,
+                        self._loco_shardings,
+                    ),
+                )
+            else:
+                self._grad_fn = self._jit(
+                    micro_step,
+                    in_shardings=(state_sh, self.batch_sharding(batch), None),
+                    out_shardings=(self._scalar_sharding, self.master_shardings_dev),
+                )
         st = jax.device_put(self.state, state_sh) if self._offload_cpu else self.state
-        loss, grads = self._grad_fn(st, batch, self._next_rng())
+        if loco:
+            # reset_T on the shim path (the fused path resets by state.step
+            # inside the jitted step): zero the buffer host-side every
+            # reset_T micro-grad computations
+            if self._loco_calls % self._loco_reset_T == 0:
+                self._loco_state = jax.tree_util.tree_map(
+                    jnp.zeros_like, self._loco_state
+                )
+            self._loco_calls += 1
+            loss, grads, self._loco_state = self._grad_fn(
+                st, batch, self._next_rng(), self._loco_state
+            )
+        else:
+            loss, grads = self._grad_fn(st, batch, self._next_rng())
         self._pending = {"grads": grads, "loss": loss}
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -995,11 +1105,39 @@ class DeepSpeedTpuEngine:
             ce.wait()
 
     def is_gradient_accumulation_boundary(self) -> bool:
-        """reference: engine.py:2166."""
+        """reference: engine.py:2166.  Inside ``no_sync`` accumulation-step
+        tracking is disabled (never a boundary), per the reference contract."""
+        if self._inside_no_sync:
+            return False
         return self._micro_steps % self.config.gradient_accumulation_steps == 0
+
+    @contextmanager
+    def no_sync(self):
+        """Suspend gradient-reduction bookkeeping during backward
+        (reference engine.py:2065).  Contract parity: (1) illegal with ZeRO
+        stage >= 2 — gradient partitioning *is* the reduction; (2) ``step()``
+        inside the context is illegal; (3) accumulation-boundary tracking is
+        disabled.  The comm-volume effect differs by construction: per-micro
+        grads here accumulate in the ZeRO-sharded master layout inside one
+        jitted step, so there is no per-backward all-reduce to elide — XLA's
+        schedule already defers cross-DP reduction to the boundary."""
+        if self.config.zero_optimization.stage >= 2:
+            raise RuntimeError(
+                "no_sync is incompatible with the gradient partitioning of "
+                f"ZeRO stage {self.config.zero_optimization.stage}"
+            )
+        if self._inside_no_sync:
+            raise RuntimeError("no_sync context manager reentry is unsupported")
+        self._inside_no_sync = True
+        try:
+            yield
+        finally:
+            self._inside_no_sync = False
 
     def step(self):
         """Apply accumulated gradients at the GAS boundary (engine.py:2282)."""
+        if self._inside_no_sync:
+            raise RuntimeError("it is illegal to call engine.step() within no_sync")
         if not self.is_gradient_accumulation_boundary():
             return
         state_sh = self._dev_state_shardings() if self._offload_cpu else self.state_shardings
@@ -1086,6 +1224,14 @@ class DeepSpeedTpuEngine:
         """Compute-dtype view of the current parameters."""
         self.flush_nvme_pipeline()  # pipelined NVMe: adopt the latest walk
         return precision.cast_floating(self.state.params, self.compute_dtype)
+
+    def memory_breakdown(self):
+        """Exact state-component byte accounting + a live device/host
+        snapshot (reference: ``memory_breakdown`` config consumed by
+        ``see_memory_usage`` call sites, runtime/utils.py:771)."""
+        from ..utils.memory import memory_breakdown_report
+
+        return memory_breakdown_report(self)
 
     def _emit_monitor(self, metrics: StepMetrics):
         if self.global_steps % self.config.steps_per_print == 0:

@@ -43,17 +43,59 @@ def _fsdp_dim(spec: P) -> Optional[int]:
     return None
 
 
+def _quant_a2a_reduce(g, dim: int, w: int):
+    """qgZ core: chunk → int8-quantize → all_to_all → dequantize-mean
+    (the reference's ``all_to_all_quant_reduce`` with the 2-hop hierarchy
+    flattened onto ICI).  ``g`` is this rank's partial cotangent for the
+    FULL parameter; returns this rank's reduced shard plus the local
+    quantization residual (``g_sent - dequant(quant(g_sent))``) for LoCo."""
+    chunks = jnp.stack(jnp.split(g, w, axis=dim))  # [W, ...chunk]
+    qt = quantize_int8(chunks)
+    rows = qt.scales.shape[0] // w
+    residual = chunks - dequantize(qt, dtype=jnp.float32)
+    recv_q = jax.lax.all_to_all(
+        qt.data, FSDP_AXIS, split_axis=0, concat_axis=0, tiled=True
+    )
+    recv_s = jax.lax.all_to_all(
+        qt.scales.reshape(w, rows), FSDP_AXIS, split_axis=0, concat_axis=0,
+        tiled=True,
+    )
+    recv_q = recv_q.reshape((w,) + chunks.shape[1:])
+    total = jnp.zeros(chunks.shape[1:], jnp.float32)
+    for i in range(w):
+        total = total + dequantize(
+            qt._replace(data=recv_q[i], scales=recv_s.reshape(w, rows)[i]),
+            dtype=jnp.float32,
+        )
+    out = total / w
+    residual = jnp.concatenate([residual[i] for i in range(w)], axis=dim)
+    return out, residual
+
+
 def _gather_leaf_fn(dim: int, w: int, out_dtype, quant_weights: bool,
-                    quant_grads: bool, data_axis: Optional[str]):
+                    quant_grads: bool, data_axis: Optional[str],
+                    loco_beta: Optional[float] = None):
     """custom_vjp: local master shard -> full compute param (inside shard_map).
 
     bwd receives this rank's *partial* cotangent and returns the fully
     reduced (mean over every DP rank) local shard gradient.
-    """
 
-    @jax.custom_vjp
-    def gather(local):
-        return _fwd_impl(local)
+    With ``loco_beta`` set (LoCo, reference
+    ``runtime/comm/coalesced_collectives.py:81 all_to_all_loco_quant_reduce``)
+    the function takes a second input — the persistent error-feedback buffer
+    — and error-compensates the quantized reduce:
+
+        comp    = g + err                 (compensate before quantizing)
+        send    = quant_int8(comp)        (compressed wire payload)
+        new_err = beta * (comp - deq(send))   (residual carries to next step)
+
+    The *updated* buffer rides out through ``err``'s cotangent slot: the
+    custom bwd fully controls what it returns there, the caller treats that
+    output as state (not a gradient), and autodiff never consumes it — this
+    is the JAX-native replacement for the reference's in-place
+    ``p.intra_ef_buf`` mutation.
+    """
+    loco = loco_beta is not None
 
     def _fwd_impl(local):
         if quant_weights:
@@ -69,31 +111,16 @@ def _gather_leaf_fn(dim: int, w: int, out_dtype, quant_weights: bool,
             pieces = [g_all[i] for i in range(w)]
         return jnp.concatenate(pieces, axis=dim)
 
-    def fwd(local):
-        return _fwd_impl(local), None
-
-    def bwd(_, g):
+    def _reduce_cotangent(g, err):
         g = g.astype(jnp.float32)
+        new_err = err
         if quant_grads:
-            # qgZ: int8 all_to_all + local dequant-mean (all_to_all_quant_reduce)
-            chunks = jnp.stack(jnp.split(g, w, axis=dim))  # [W, ...chunk]
-            qt = quantize_int8(chunks)
-            rows = qt.scales.shape[0] // w
-            recv_q = jax.lax.all_to_all(
-                qt.data, FSDP_AXIS, split_axis=0, concat_axis=0, tiled=True
-            )
-            recv_s = jax.lax.all_to_all(
-                qt.scales.reshape(w, rows), FSDP_AXIS, split_axis=0, concat_axis=0,
-                tiled=True,
-            )
-            recv_q = recv_q.reshape((w,) + chunks.shape[1:])
-            total = jnp.zeros(chunks.shape[1:], jnp.float32)
-            for i in range(w):
-                total = total + dequantize(
-                    qt._replace(data=recv_q[i], scales=recv_s.reshape(w, rows)[i]),
-                    dtype=jnp.float32,
-                )
-            out = total / w
+            if loco:
+                comp = g + err[0]
+                out, residual = _quant_a2a_reduce(comp, dim, w)
+                new_err = (loco_beta * residual)[None]
+            else:
+                out, _ = _quant_a2a_reduce(g, dim, w)
         else:
             out = (
                 jax.lax.psum_scatter(g, FSDP_AXIS, scatter_dimension=dim, tiled=True)
@@ -101,6 +128,32 @@ def _gather_leaf_fn(dim: int, w: int, out_dtype, quant_weights: bool,
             )
         if data_axis is not None:
             out = jax.lax.pmean(out, data_axis)
+        return out, new_err
+
+    if loco:
+        @jax.custom_vjp
+        def gather(local, err):
+            return _fwd_impl(local)
+
+        def fwd(local, err):
+            return _fwd_impl(local), err
+
+        def bwd(err, g):
+            out, new_err = _reduce_cotangent(g, err)
+            return out, new_err
+
+        gather.defvjp(fwd, bwd)
+        return gather
+
+    @jax.custom_vjp
+    def gather(local):
+        return _fwd_impl(local)
+
+    def fwd(local):
+        return _fwd_impl(local), None
+
+    def bwd(_, g):
+        out, _unused = _reduce_cotangent(g, None)
         return (out,)
 
     gather.defvjp(fwd, bwd)
@@ -114,17 +167,34 @@ def make_micro_value_and_grad(
     compute_dtype,
     quant_weights: bool,
     quant_grads: bool,
+    loco_param: Optional[dict] = None,
 ):
     """Returns ``fn(masters, micro_batch, rng, scale) -> (loss, grads)`` —
     the ZeRO++ replacement for the engine's ``_micro_value_and_grad``.
 
     ``grads`` come out sharded exactly like ``masters`` (fsdp shards), fully
     reduced; ``loss`` is the global mean.
+
+    With ``loco_param`` (``{"err_beta": float, "reset_T": int}``, the
+    reference's ``zeropp_loco_param`` schema, zero/config.py:315) the
+    signature becomes ``fn(masters, err, micro_batch, rng, scale) ->
+    (loss, grads, new_err)``: ``err`` is the persistent error-feedback
+    pytree built by :func:`init_loco_state`, compensating the lossy int8
+    gradient reduce across steps (LoCo).  ``reset_T`` is applied by the
+    caller (the engine zeroes the buffer every ``reset_T`` steps — the
+    reference's ``loco_idx > reset_T`` reset).
     """
     w = mesh.shape[FSDP_AXIS]
     has_data = mesh.shape.get(DATA_AXIS, 1) > 1
     data_axis = DATA_AXIS if has_data else None
     dp_axes = (DATA_AXIS, FSDP_AXIS) if has_data else (FSDP_AXIS,)  # sub>1 + ZeRO++ unsupported
+    loco = loco_param is not None
+    if loco and (not quant_grads or has_data):
+        raise ValueError(
+            "zeropp_loco_param requires zero_quantized_gradients and a pure "
+            "fsdp DP layout (data axis 1) — the error buffer is per-fsdp-rank"
+        )
+    loco_beta = float(loco_param.get("err_beta", 0.8)) if loco else None
 
     specs_flat = master_specs
 
@@ -136,9 +206,16 @@ def make_micro_value_and_grad(
 
     master_in_specs = jax.tree_util.tree_map(in_spec_for, specs_flat)
 
-    def body(masters_local, micro_local, rng, scale):
-        def local_loss(ml):
-            def leaf(x, spec):
+    def err_spec_for(spec: P) -> P:
+        # err leaves: [W, *full_param] split on dim 0; non-fsdp leaves carry
+        # an empty placeholder so the pytrees stay congruent
+        return P(FSDP_AXIS) if _fsdp_dim(spec) is not None and w > 1 else P()
+
+    err_in_specs = jax.tree_util.tree_map(err_spec_for, specs_flat)
+
+    def body(masters_local, err_local, micro_local, rng, scale):
+        def local_loss(ml, el):
+            def leaf(x, e, spec):
                 dim = _fsdp_dim(spec)
                 if dim is None or w == 1:
                     return (
@@ -146,36 +223,64 @@ def make_micro_value_and_grad(
                         if jnp.issubdtype(x.dtype, jnp.floating)
                         else x
                     )
-                return _gather_leaf_fn(
-                    dim, w, compute_dtype, quant_weights, quant_grads, data_axis
-                )(x)
+                g = _gather_leaf_fn(
+                    dim, w, compute_dtype, quant_weights, quant_grads,
+                    data_axis, loco_beta,
+                )
+                return g(x, e) if loco else g(x)
 
-            cp = jax.tree_util.tree_map(leaf, ml, specs_flat)
+            cp = jax.tree_util.tree_map(leaf, ml, el, specs_flat)
             return loss_fn(cp, micro_local, rng) * scale
-
-        loss, grads = jax.value_and_grad(local_loss)(masters_local)
 
         def finish(g, spec):
             if _fsdp_dim(spec) is None or w == 1:
                 return jax.lax.pmean(g.astype(jnp.float32), dp_axes)
             return g  # custom bwd already reduced across every DP rank
 
+        if loco:
+            loss, (grads, new_err) = jax.value_and_grad(local_loss, argnums=(0, 1))(
+                masters_local, err_local
+            )
+            # non-participating err leaves get autodiff zeros; keep the
+            # incoming buffer instead so their (empty) state is stable
+            new_err = jax.tree_util.tree_map(
+                lambda ne, e, spec: ne if _fsdp_dim(spec) is not None and w > 1 else e,
+                new_err, err_local, specs_flat,
+            )
+            grads = jax.tree_util.tree_map(finish, grads, specs_flat)
+            return jax.lax.pmean(loss, dp_axes), grads, new_err
+
+        loss, grads = jax.value_and_grad(lambda ml: local_loss(ml, err_local))(
+            masters_local
+        )
         grads = jax.tree_util.tree_map(finish, grads, specs_flat)
         return jax.lax.pmean(loss, dp_axes), grads
 
     batch_entry = dp_axes if has_data else FSDP_AXIS
 
-    def fn(masters, micro_batch, rng, scale):
+    def fn(masters, *args):
         from ..parallel import sharding as _sh
 
+        if loco:
+            err, micro_batch, rng, scale = args
+        else:
+            micro_batch, rng, scale = args
+            err = jax.tree_util.tree_map(
+                lambda _: jnp.zeros((0,), jnp.float32), specs_flat
+            )
         batch_specs = jax.tree_util.tree_map(
             lambda x: P(*((batch_entry,) + (None,) * (x.ndim - 1))), micro_batch
+        )
+        out_specs = (
+            (P(), master_in_specs, err_in_specs)
+            if loco
+            else (P(), master_in_specs)
         )
         mapped = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(master_in_specs, batch_specs, P(), P()),
-            out_specs=(P(), master_in_specs),
+            in_specs=(master_in_specs, err_in_specs, batch_specs, P(), P()),
+            out_specs=out_specs,
             axis_names=set(dp_axes),
             check_vma=False,
         )
@@ -183,9 +288,38 @@ def make_micro_value_and_grad(
         prev = _sh.get_current_mesh()
         _sh.set_current_mesh(None)
         try:
-            loss, grads = mapped(masters, micro_batch, rng, jnp.asarray(scale, jnp.float32))
+            out = mapped(masters, err, micro_batch, rng, jnp.asarray(scale, jnp.float32))
         finally:
             _sh.set_current_mesh(prev)
-        return loss, grads
+        return out
 
     return fn
+
+
+def init_loco_state(mesh, master_shapes, master_specs):
+    """Zero-initialized LoCo error-feedback pytree, sharded ``P(fsdp)`` on a
+    leading world dimension: leaf shape ``[W, *param_shape]`` for
+    fsdp-sharded params (each rank persists its residual for the FULL
+    parameter it error-compensates), empty placeholders elsewhere.  The
+    reference's per-tensor ``intra_ef_buf`` carries the same per-rank cost
+    (coalesced_collectives.py:113)."""
+    from jax.sharding import NamedSharding
+
+    w = mesh.shape[FSDP_AXIS]
+
+    def participates(spec) -> bool:
+        return _fsdp_dim(spec) is not None and w > 1
+
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, P(FSDP_AXIS) if participates(spec) else P()),
+        master_specs,
+    )
+    vals = jax.tree_util.tree_map(
+        lambda shape_leaf, spec: jnp.zeros(
+            (w,) + tuple(shape_leaf.shape) if participates(spec) else (0,),
+            jnp.float32,
+        ),
+        master_shapes,
+        master_specs,
+    )
+    return jax.device_put(vals, shardings), shardings

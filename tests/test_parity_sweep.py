@@ -1,0 +1,301 @@
+"""Round-5 parity-hole sweep: no_sync, memory report, LoCo, Comet, IMPI,
+ds_io registration, sparse embedding grads.
+
+Reference touchstones: engine.py:2065 (no_sync), runtime/utils.py:771
+(see_memory_usage), runtime/comm/coalesced_collectives.py:81 (LoCo),
+monitor/comet.py, launcher/multinode_runner.py:272 (IMPI), bin/ds_io,
+runtime/sparse_tensor.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from simple_model import init_mlp, mlp_loss, random_batches
+
+CFG = {
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "bf16": {"enabled": False},
+    "steps_per_print": 100,
+}
+
+
+def _engine(zero=None, mesh_axes=None, extra=None):
+    params = init_mlp(jax.random.PRNGKey(0), in_dim=8, hidden=64, out_dim=8)
+    cfg = {**CFG, **(extra or {})}
+    if zero is not None:
+        cfg["zero_optimization"] = zero
+    mesh = deepspeed_tpu.initialize_mesh(**(mesh_axes or {"fsdp": 8}))
+    return deepspeed_tpu.initialize(
+        loss_fn=mlp_loss, params=params, config=cfg, mesh=mesh
+    )[0]
+
+
+# ---------------------------------------------------------------------------
+# no_sync (engine.py:2065)
+# ---------------------------------------------------------------------------
+def test_no_sync_contract():
+    engine = _engine(zero={"stage": 1}, extra={"gradient_accumulation_steps": 2})
+    b = random_batches(1, 1, 16)[0]
+    micro = {k: v[0] for k, v in b.items()}
+    with engine.no_sync():
+        loss = engine.forward(micro)
+        engine.backward(loss)
+        # boundary tracking disabled inside the context
+        assert not engine.is_gradient_accumulation_boundary()
+        with pytest.raises(RuntimeError, match="illegal"):
+            engine.step()
+        # reentry unsupported
+        with pytest.raises(RuntimeError, match="reentry"):
+            with engine.no_sync():
+                pass
+    # grads accumulated inside the context still apply at the next boundary
+    loss = engine.forward(micro)
+    engine.backward(loss)
+    assert engine.is_gradient_accumulation_boundary()
+    before = engine.global_steps
+    engine.step()
+    assert engine.global_steps == before + 1
+
+
+def test_no_sync_rejects_grad_partitioning():
+    engine = _engine(zero={"stage": 2})
+    with pytest.raises(RuntimeError, match="ZeRO stage 2"):
+        with engine.no_sync():
+            pass
+
+
+# ---------------------------------------------------------------------------
+# memory report (runtime/utils.py:771)
+# ---------------------------------------------------------------------------
+def test_see_memory_usage_and_breakdown():
+    from deepspeed_tpu.utils.memory import see_memory_usage
+
+    assert see_memory_usage("gated off") is None  # force=False is a no-op
+    snap = see_memory_usage("unit test", force=True)
+    assert snap["host_rss_gb"] > 0
+    for k in ("device_bytes_in_use", "device_peak_bytes", "device_bytes_limit"):
+        assert k in snap
+
+    engine = _engine(zero={"stage": 1}, extra={"memory_breakdown": True})
+    engine.train_batch(random_batches(1, 1, 16)[0])
+    report = engine.memory_breakdown()
+    # fp32 masters + adam m/v: opt state ~2x params
+    assert report["master_params_bytes"] > 0
+    assert report["opt_state_bytes"] >= report["master_params_bytes"]
+    assert report["state_total_bytes"] == (
+        report["master_params_bytes"] + report["opt_state_bytes"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# LoCo (coalesced_collectives.py:81 all_to_all_loco_quant_reduce)
+# ---------------------------------------------------------------------------
+def _loco_zero(reset_T=1024):
+    return {
+        "stage": 3,
+        "param_persistence_threshold": 0,
+        "zero_quantized_gradients": True,
+        "zeropp_loco_param": {"err_beta": 0.8, "reset_T": reset_T},
+    }
+
+
+def test_loco_trains_and_tracks_dense():
+    ref = [
+        float(_engine(zero={"stage": 3, "param_persistence_threshold": 0}).train_batch(b))
+        for b in random_batches(1, 1, 16)
+    ]
+    engine = _engine(zero=_loco_zero())
+    losses = [float(engine.train_batch(b)) for b in random_batches(6, 1, 16)]
+    assert losses[-1] < losses[0]
+    np.testing.assert_allclose(losses[0], ref[0], rtol=0.1, atol=0.05)
+    # error-feedback buffers actually carry state after stepping
+    err_norm = sum(
+        float(jnp.sum(jnp.abs(e)))
+        for e in jax.tree_util.tree_leaves(engine._loco_state)
+    )
+    assert err_norm > 0, "LoCo error buffer never updated"
+
+
+def test_loco_error_feedback_converges_to_exact_mean():
+    """The defining property of error feedback (LoCo): with a CONSTANT
+    incoming gradient, the time-average of the compensated quantized reduce
+    converges to the exact reduction, while the memoryless quantized reduce
+    repeats the same biased output forever.  Exercised directly on the
+    gather leaf's custom VJP under shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.runtime.zeropp import _gather_leaf_fn
+
+    w = 8
+    mesh = jax.make_mesh((w,), ("fsdp",))
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 4))
+    # constant, deliberately awkward cotangent (non-uniform magnitudes so
+    # int8 group quantization has real bias)
+    cot = jax.random.normal(jax.random.PRNGKey(1), (w, 64, 4)) * jnp.logspace(
+        -2, 0, 4
+    )
+    err0 = jnp.zeros((w, 64, 4))
+
+    def one_step(loco_beta):
+        gather = _gather_leaf_fn(
+            0, w, jnp.float32, False, True, None, loco_beta
+        )
+
+        def body(xl, el, cl):
+            # cl arrives as [1, *full] (leading world dim split); the gather
+            # output cotangent is the bare [*full]
+            if loco_beta is None:
+                _, vjp = jax.vjp(gather, xl)
+                (gx,) = vjp(cl[0])
+                return gx, el
+            _, vjp = jax.vjp(gather, xl, el)
+            gx, new_err = vjp(cl[0])
+            return gx, new_err
+
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P("fsdp"), P("fsdp"), P("fsdp")),
+                out_specs=(P("fsdp"), P("fsdp")),
+                check_vma=False,
+            )
+        )
+
+    # exact reduction: mean over ranks of each rank's full cotangent, sliced
+    exact = np.asarray(jnp.mean(cot, axis=0))
+
+    def run(loco_beta, steps=12):
+        step = one_step(loco_beta)
+        err = err0
+        outs = []
+        for _ in range(steps):
+            gx, err = step(x, err, cot)
+            outs.append(np.asarray(gx))
+        return np.mean(outs, axis=0)
+
+    dev_plain = np.abs(run(None) - exact).max()
+    dev_loco = np.abs(run(1.0) - exact).max()
+    assert dev_plain > 0, "toy cotangent quantized exactly; pick a harder one"
+    assert dev_loco < dev_plain * 0.5, (dev_loco, dev_plain)
+
+
+def test_loco_requires_qgz():
+    with pytest.raises(Exception, match="loco"):
+        _engine(zero={
+            "stage": 3,
+            "param_persistence_threshold": 0,
+            "zero_quantized_weights": True,
+            "zeropp_loco_param": {"err_beta": 0.8},
+        })
+
+
+# ---------------------------------------------------------------------------
+# Comet monitor (monitor/comet.py)
+# ---------------------------------------------------------------------------
+def test_comet_config_parses_and_degrades():
+    from deepspeed_tpu.config.config import parse_config
+    from deepspeed_tpu.monitor.monitor import CometMonitor, MonitorMaster
+
+    cfg = parse_config({
+        "comet": {
+            "enabled": True,
+            "project": "p",
+            "workspace": "w",
+            "experiment_name": "e",
+        }
+    })
+    assert cfg.comet.enabled and cfg.comet.workspace == "w"
+    m = CometMonitor(cfg.comet)
+    # comet_ml SDK is not in this image: writer must disable itself cleanly
+    assert not m.enabled
+    master = MonitorMaster(cfg)
+    master.write_events([("Train/loss", 1.0, 1)])  # no-throw
+
+
+# ---------------------------------------------------------------------------
+# IMPI runner (multinode_runner.py:272)
+# ---------------------------------------------------------------------------
+def test_impi_runner_command():
+    from deepspeed_tpu.launcher.multinode_runner import RUNNERS, get_runner
+
+    assert "impi" in RUNNERS
+    r = get_runner("impi", {"host-a": 1, "host-b": 1}, coordinator="host-a")
+    cmd = r.get_cmd(["python", "train.py"])
+    assert cmd[:3] == ["mpirun", "-ppn", "1"]
+    joined = " ".join(cmd)
+    assert "-hosts host-a,host-b" in joined
+    assert "-genv I_MPI_PIN 0" in joined
+    # one -n 1 block per host with explicit ranks, ':'-joined
+    assert cmd.count(":") == 1
+    assert joined.count("DSTPU_PROCESS_ID") == 2
+    assert "python train.py" in joined
+
+
+# ---------------------------------------------------------------------------
+# ds_io console script (bin/ds_io)
+# ---------------------------------------------------------------------------
+def test_ds_io_registered():
+    import pathlib
+
+    from deepspeed_tpu.nvme import bench
+
+    assert callable(bench.main)
+    pyproject = pathlib.Path(__file__).resolve().parents[1] / "pyproject.toml"
+    assert 'ds_io = "deepspeed_tpu.nvme.bench:main"' in pyproject.read_text()
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding gradients (runtime/sparse_tensor.py)
+# ---------------------------------------------------------------------------
+def test_sparse_embedding_grad_matches_dense_local():
+    from deepspeed_tpu.ops.sparse_grads import embedding_lookup
+
+    table = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    ids = jnp.array([[1, 5, 1], [0, 31, 5]])
+
+    def loss_sparse(t):
+        return jnp.sum(embedding_lookup(t, ids, None) ** 2)
+
+    def loss_dense(t):
+        return jnp.sum(jnp.take(t, ids, axis=0) ** 2)
+
+    gs = jax.grad(loss_sparse)(table)
+    gd = jax.grad(loss_dense)(table)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gd), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_embedding_grad_dp_reduction():
+    """Under shard_map over a DP axis the sparse path must equal the dense
+    pmean'd gradient while shipping only rows+ids on the wire."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.ops.sparse_grads import embedding_lookup
+
+    mesh = jax.make_mesh((8,), ("data",))
+    table = jax.random.normal(jax.random.PRNGKey(0), (64, 16))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 4), 0, 64)
+
+    def body(t, i):
+        def loss(tt):
+            return jnp.mean(embedding_lookup(tt, i, "data") ** 2)
+
+        return jax.grad(loss)(t)
+
+    g_sparse = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+            check_vma=False,
+        )
+    )(table, ids)
+
+    def dense_loss(t):
+        return jnp.mean(jnp.take(t, ids, axis=0) ** 2)
+
+    g_dense = jax.grad(dense_loss)(table)
+    np.testing.assert_allclose(
+        np.asarray(g_sparse), np.asarray(g_dense), rtol=1e-5, atol=1e-6
+    )
