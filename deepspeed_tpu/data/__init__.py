@@ -4,6 +4,12 @@ TPU-native analogue of ``deepspeed/runtime/data_pipeline/`` (data_sampler.py,
 curriculum_scheduler.py, indexed_dataset.py).
 """
 from .curriculum_scheduler import CurriculumScheduler, truncate_to_seqlen  # noqa: F401
+from .data_analyzer import (  # noqa: F401
+    CurriculumDataSampler,
+    CurriculumIndex,
+    DataAnalyzer,
+    curriculum_index_filter,
+)
 from .indexed_dataset import (  # noqa: F401
     MMapIndexedDataset,
     MMapIndexedDatasetBuilder,

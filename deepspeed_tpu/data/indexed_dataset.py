@@ -68,6 +68,7 @@ class MMapIndexedDataset:
     """Zero-copy reads of sequence ``i`` via ``np.memmap``."""
 
     def __init__(self, prefix: str, skip_warmup: bool = True):
+        self.prefix = prefix  # re-openable handle (data_analyzer map jobs)
         with open(index_file_path(prefix), "rb") as fh:
             magic = fh.read(len(_MAGIC))
             if magic != _MAGIC:

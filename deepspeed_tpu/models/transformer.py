@@ -27,6 +27,7 @@ TPU-native design decisions:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -295,6 +296,41 @@ def mlp_block(lw: Params, x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray
     return h @ lw["w_down"]
 
 
+@functools.lru_cache(maxsize=None)
+def _tp_copy_fn(axis: str):
+    """Megatron's 'f' operator for MANUAL tensor parallelism: identity in
+    forward, ``psum`` over the TP axis in backward.  Needed wherever a
+    replicated activation fans out into column-parallel shards inside a
+    fully-manual ``shard_map`` region (the pipelined executor) — each
+    rank's branch cotangent is partial and must be summed.  Under GSPMD
+    (the dense path) this is implicit; reference analogue:
+    module_inject/layers.py:66 row/col autograd fns."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, g: (jax.lax.psum(g, axis),))
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_psum_fn(axis: str):
+    """Megatron's 'g' operator: ``psum`` in forward (row-parallel partial
+    sums), IDENTITY in backward — the cotangent of the summed output is
+    already replicated across TP ranks.  A raw ``lax.psum`` must not be
+    used here: under ``shard_map`` with unreplicated-value semantics
+    (check_vma=False) its autodiff transpose is another psum, which
+    multiplies every upstream cotangent by the TP degree per layer."""
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+
+    g.defvjp(lambda x: (jax.lax.psum(x, axis), None), lambda _, ct: (ct,))
+    return g
+
+
 def decoder_layer(
     lw: Params,
     x: jnp.ndarray,
@@ -304,18 +340,32 @@ def decoder_layer(
     segment_ids: Optional[jnp.ndarray] = None,
     cache: Optional[Tuple] = None,
     cache_index: Optional[jnp.ndarray] = None,
+    tp_axis: Optional[str] = None,
 ):
-    """Pre-norm residual block. Returns (x, new_cache, aux_loss)."""
+    """Pre-norm residual block. Returns (x, new_cache, aux_loss).
+
+    ``tp_axis`` activates MANUAL Megatron TP for use inside fully-manual
+    shard_map regions: the caller passes cfg with LOCAL head counts and
+    model-sharded weights (wq/wk/wv/w_up/w_gate column-parallel, wo/w_down
+    row-parallel); this function inserts the f/g collective pair — identity-
+    fwd/psum-bwd at each branch input, psum-fwd at each branch output.
+    Under GSPMD (tp_axis=None) the same layout comes from tp_rules specs.
+    """
+    if tp_axis is not None and cfg.moe_num_experts > 0:
+        raise NotImplementedError("manual TP inside MoE layers is unsupported")
     dtype = x.dtype
+    tp_in = _tp_copy_fn(tp_axis) if tp_axis is not None else (lambda v: v)
     attn_in = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
     if cfg.act_quant_bits:
         from ..compression.compress import quantize_activation
 
         attn_in = quantize_activation(attn_in, cfg.act_quant_bits)
     h, new_cache = attention_block(
-        lw["attn"], attn_in, cfg,
+        lw["attn"], tp_in(attn_in), cfg,
         positions, attn_fn, segment_ids, cache, cache_index,
     )
+    if tp_axis is not None:
+        h = _tp_psum_fn(tp_axis)(h)  # row-parallel wo partial sums
     x = shard_activation(x + h.astype(dtype), ACT_SPEC)
     aux = jnp.asarray(0.0, jnp.float32)
     y = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
@@ -328,7 +378,9 @@ def decoder_layer(
 
         h, aux = moe_block(lw["moe"], y, cfg)
     else:
-        h = mlp_block(lw["mlp"], y, cfg)
+        h = mlp_block(lw["mlp"], tp_in(y), cfg)
+    if tp_axis is not None:
+        h = _tp_psum_fn(tp_axis)(h)  # row-parallel w_down partial sums
     x = shard_activation(x + h.astype(dtype), ACT_SPEC)
     return x, new_cache, aux
 

@@ -57,6 +57,7 @@ def pipeline_apply(
     mesh=None,
     with_aux: bool = False,
     extra: Any = None,
+    tp_layer_specs: Any = None,
 ):
     """Run a stacked layer pytree (leading dim L, L % num_stages == 0) over
     activations ``x`` [B, ...] split into ``num_micro`` microbatches.
@@ -294,6 +295,18 @@ def pipeline_apply(
         lambda leaf: P(*((STAGE_AXIS,) + (None,) * (leaf.ndim - 1))), layer_params
     )
 
+    # The region stays FULLY manual: partial-auto (axis_names as a strict
+    # subset of the mesh axes) hits an XLA partitioner CHECK failure,
+    # 'Invalid binary instruction opcode copy', even when every auto axis
+    # has size 1 and nothing is differentiated through the region.  Tensor
+    # parallelism therefore composes EXPLICITLY: ``tp_layer_specs`` shards
+    # layer weights on the model axis inside the region and the layer_fn
+    # carries Megatron-style psums (models/transformer.py
+    # decoder_layer(tp_axis=...)) — true PP x TP, no boundary gathers
+    # (reference 3D grid, pipe/topology.py:251).
+    if tp_layer_specs is not None:
+        layer_specs = tp_layer_specs
+
     fwd_sm = jax.shard_map(
         fwd_body,
         mesh=mesh,
@@ -399,12 +412,49 @@ class PipelinedCausalLM:
         aux loss is validity-gated per tick and psum'd across stages).
         Packed-sequence ``segment_ids`` ride the pipeline as the per-
         microbatch ``extra`` input (the reference TrainSchedule is agnostic
-        to packing; so is this executor)."""
+        to packing; so is this executor).
+
+        When the mesh carries a >1 ``model`` axis, the stack runs MANUAL
+        Megatron TP inside the fully-manual pipeline region: layer weights
+        enter model-sharded (``tp_layer_specs``), the layer body uses LOCAL
+        head counts, and ``decoder_layer(tp_axis=...)`` supplies the f/g
+        psum pair — the reference's PP x TP 3D grid (pipe/topology.py:251)
+        without leaving the single fused executor."""
         from ...models.transformer import _get_attn_fn, decoder_layer
+        from ...parallel.sharding import get_current_mesh
+        from ...parallel.topology import MODEL_AXIS
+
+        mesh = get_current_mesh()
+        tp = 1
+        if mesh is not None:
+            tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(MODEL_AXIS, 1)
+        cfg = self.cfg
+        tp_axis = None
+        tp_layer_specs = None
+        if tp > 1:
+            if cfg.moe_num_experts > 0:
+                raise NotImplementedError(
+                    "PP x TP with MoE layers is unsupported (manual TP "
+                    "excludes expert dispatch); use PP x EP instead"
+                )
+            if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"num_heads {cfg.num_heads} / num_kv_heads "
+                    f"{cfg.num_kv_heads} must divide the model axis ({tp})"
+                )
+            tp_axis = MODEL_AXIS
+            # local view: heads shrink, head_dim pinned (hd is derived from
+            # num_heads unless explicit)
+            cfg = cfg.replace(
+                head_dim=cfg.hd,
+                num_heads=cfg.num_heads // tp,
+                num_kv_heads=cfg.num_kv_heads // tp,
+            )
+            tp_layer_specs = self._tp_layer_specs(layer_params)
 
         # the cfg-driven dispatch (sparse layouts included) — NOT the raw
         # impl lookup, which would silently drop cfg.sparse_attention
-        attn_fn = _get_attn_fn(self.cfg)
+        attn_fn = _get_attn_fn(cfg)
         # positions are identical for every microbatch; use the 1-D [s] form
         # so the layer body broadcasts over whatever microbatch size it sees
         pos1d = positions[0] if positions.ndim == 2 else positions
@@ -412,18 +462,37 @@ class PipelinedCausalLM:
         if segment_ids is not None:
             def layer_fn(h, lw, seg):
                 h, _, aux = decoder_layer(
-                    lw, h, self.cfg, pos1d, attn_fn, segment_ids=seg
+                    lw, h, cfg, pos1d, attn_fn, segment_ids=seg,
+                    tp_axis=tp_axis,
                 )
                 return h, aux
         else:
             def layer_fn(h, lw):
-                h, _, aux = decoder_layer(lw, h, self.cfg, pos1d, attn_fn)
+                h, _, aux = decoder_layer(
+                    lw, h, cfg, pos1d, attn_fn, tp_axis=tp_axis
+                )
                 return h, aux
 
         return pipeline_apply(
             layer_params, x, layer_fn, self.num_stages, self.num_micro,
             with_aux=True, extra=segment_ids,
+            tp_layer_specs=tp_layer_specs,
         )
+
+    def _tp_layer_specs(self, layer_params):
+        """Per-leaf shard_map in_specs for the layer subtree: stage on the
+        layer dim + the tp_rules model-axis placement on row/col dims."""
+        from ...models.transformer import tp_rules as base_rules
+        from ...runtime.zero import match_rules, path_str
+
+        rules = base_rules(self.cfg)
+
+        def leaf_spec(path, leaf):
+            p = "layers/" + path_str(path)
+            base = match_rules(p, leaf.shape, rules)
+            return P(*((STAGE_AXIS,) + tuple(base)[1:]))
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, layer_params)
 
     def loss_fn(self, params, batch, rng=None):
         return self._inner.loss_fn(params, batch, rng)

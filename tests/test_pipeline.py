@@ -536,3 +536,62 @@ def test_pipelined_packed_segments_match_dense(stage_mesh):
     g = jax.jit(jax.grad(lambda p: piped.loss_fn(p, batch)))(params)
     assert all(np.isfinite(np.asarray(x, np.float32)).all()
                for x in jax.tree_util.tree_leaves(g))
+
+
+def test_pipelined_tp_composition_matches_dense():
+    """PP x TP (r4 VERDICT next #5): the pipelined stack with a >1 model
+    axis runs MANUAL Megatron TP inside the fully-manual region (local
+    heads + f/g psums, model-sharded weights) — loss and grads must match
+    the dense single-device path."""
+    grid = initialize_mesh(stage=2, model=2, fsdp=2)
+    set_current_mesh(grid.mesh)
+    try:
+        cfg = get_preset("tiny", num_layers=4)
+        assert cfg.num_heads % 2 == 0 and cfg.num_kv_heads % 2 == 0
+        dense = CausalLM(cfg)
+        piped = PipelinedCausalLM(cfg, num_stages=2, num_micro=2)
+        params = dense.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(rng.integers(0, 64, (4, 17)))}
+        l_dense = float(jax.jit(dense.loss_fn)(params, batch))
+        l_piped = float(jax.jit(piped.loss_fn)(params, batch))
+        assert abs(l_dense - l_piped) < 2e-3, (l_dense, l_piped)
+        gd = jax.jit(jax.grad(lambda p: dense.loss_fn(p, batch)))(params)
+        gp = jax.jit(jax.grad(lambda p: piped.loss_fn(p, batch)))(params)
+        for pd, pp_ in zip(
+            jax.tree_util.tree_leaves(gd), jax.tree_util.tree_leaves(gp)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(pd, np.float32), np.asarray(pp_, np.float32),
+                atol=5e-3, rtol=5e-2,
+            )
+    finally:
+        set_current_mesh(None)
+
+
+def test_pipelined_tp_trains_end_to_end():
+    """PP x TP x fsdp through the full engine (dryrun_multichip case 6's
+    shape, asserted here on the CPU mesh)."""
+    import deepspeed_tpu as ds
+
+    grid = initialize_mesh(stage=2, model=2, fsdp=2)
+    set_current_mesh(grid.mesh)
+    try:
+        cfg = get_preset("tiny", num_layers=4)
+        model = PipelinedCausalLM(cfg, num_stages=2, num_micro=2)
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 1000,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config, mesh=grid)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 64, (1, 4, 17), dtype=np.int64)}
+        first = float(engine.train_batch(batch))
+        for _ in range(15):
+            loss = float(engine.train_batch(batch))
+        assert loss < first * 0.8, (first, loss)
+    finally:
+        set_current_mesh(None)
