@@ -111,6 +111,18 @@ def magnitude_prune_mask(x: jnp.ndarray, dense_ratio: jnp.ndarray | float) -> jn
     return (jnp.abs(x.astype(jnp.float32)) >= threshold).astype(x.dtype)
 
 
+def structured_keep_mask(scores: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Boolean keep-mask over the LAST axis of ``scores``: the top
+    ``dense_ratio`` fraction of units (rows / heads / channels) survive.
+    ``dense_ratio`` is static — the keep count is a compile-time constant,
+    so the sliced export (redundancy_clean) has a static shape."""
+    width = scores.shape[-1]
+    k = max(1, int(round(width * float(dense_ratio))))
+    # rank-based (argsort of argsort) so exactly k units survive even on ties
+    order = jnp.argsort(jnp.argsort(-scores, axis=-1, stable=True), axis=-1, stable=True)
+    return order < k
+
+
 def quantize_activation(
     x: jnp.ndarray, bits: int = 8, symmetric: bool = True, static_range: Optional[float] = None
 ) -> jnp.ndarray:
@@ -132,6 +144,7 @@ class TechniqueGroup:
     name: str
     modules: List[str]  # regexes over param paths
     params: Dict[str, Any]
+    related_modules: List[str] = field(default_factory=list)  # input-dim twins
 
 
 @dataclass
@@ -145,14 +158,18 @@ class Technique:
         if not block:
             return cls()
         shared = dict(block.get("shared_parameters", {}))
-        groups = [
-            TechniqueGroup(
+        groups = []
+        for name, g in (block.get("different_groups", {}) or {}).items():
+            related = g.get("related_modules") or []
+            # reference nests related_modules as a list of lists
+            if related and isinstance(related[0], (list, tuple)):
+                related = [rx for sub in related for rx in sub]
+            groups.append(TechniqueGroup(
                 name=name,
                 modules=list(g.get("modules", [".*"])),
                 params=dict(g.get("params", {})),
-            )
-            for name, g in (block.get("different_groups", {}) or {}).items()
-        ]
+                related_modules=list(related),
+            ))
         return cls(enabled=bool(shared.get("enabled", False)), shared=shared, groups=groups)
 
     def group_for(self, path: str) -> Optional[TechniqueGroup]:
@@ -170,6 +187,12 @@ class CompressionManager:
         self.weight_quant = Technique.parse(cd.get("weight_quantization"))
         self.act_quant = Technique.parse(cd.get("activation_quantization"))
         self.pruning = Technique.parse(cd.get("sparse_pruning"))
+        # structured techniques (reference basic_layer.py LinearLayer_Compress
+        # row/head/channel prune variants, constants.py:137-180)
+        self.row_pruning = Technique.parse(cd.get("row_pruning"))
+        self.head_pruning = Technique.parse(cd.get("head_pruning"))
+        self.channel_pruning = Technique.parse(cd.get("channel_pruning"))
+        self.layer_reduction = dict(cd.get("layer_reduction") or {})
         if self.pruning.enabled:
             method = self.pruning.shared.get("method", "l1")
             if method not in ("l1", "topk"):
@@ -177,12 +200,104 @@ class CompressionManager:
                     f"sparse_pruning method '{method}' unsupported (l1|topk; "
                     "snip_momentum needs the reference's neural_compressor)"
                 )
+        if self.head_pruning.enabled and "num_heads" not in self.head_pruning.shared:
+            raise ValueError(
+                "head_pruning.shared_parameters.num_heads is required "
+                "(reference constants.py:168)"
+            )
+
+    @property
+    def _structured(self) -> List[Tuple[str, Technique]]:
+        return [
+            ("row", self.row_pruning),
+            ("head", self.head_pruning),
+            ("channel", self.channel_pruning),
+        ]
 
     @property
     def any_weight_transform(self) -> bool:
-        return (self.weight_quant.enabled and bool(self.weight_quant.groups)) or (
-            self.pruning.enabled and bool(self.pruning.groups)
+        return (
+            (self.weight_quant.enabled and bool(self.weight_quant.groups))
+            or (self.pruning.enabled and bool(self.pruning.groups))
+            or any(t.enabled and bool(t.groups) for _, t in self._structured)
         )
+
+    # -- structured masks ----------------------------------------------------
+    def _structured_unit_dim(self, kind: str, leaf) -> int:
+        """Which axis carries the prunable units.  Kernels here are stored
+        [..., in, out] (row-parallel layout): output rows/heads live on the
+        LAST axis; 'channel' targets conv kernels [h, w, cin, cout] — also
+        the last axis.  (The reference's torch Linears are [out, in]; the
+        semantic — prune output units — is identical.)"""
+        return leaf.ndim - 1
+
+    def _structured_masks(self, kind: str, tech: Technique, flat: Dict[str, Any]):
+        """Per-group keep-masks: score over every module-matched leaf (L1
+        over non-unit dims, heads grouped when kind='head'), combined, one
+        mask per group.  Returns {path: (mask_over_units, axis, grouped)}
+        covering modules (output axis) AND related_modules (input axis)."""
+        num_heads = int(tech.shared.get("num_heads", 0)) if kind == "head" else 0
+        out: Dict[str, Tuple[jnp.ndarray, int, int]] = {}
+        for g in tech.groups:
+            matched = [
+                (p, leaf) for p, leaf in flat.items()
+                if leaf.ndim >= 2 and any(re.search(rx, p) for rx in g.modules)
+            ]
+            if not matched:
+                continue
+            dense_ratio = float(g.params.get("dense_ratio", 0.5))
+            # combined unit scores across matched leaves (w_up + w_gate case)
+            scores = None
+            for p, leaf in matched:
+                x = jnp.abs(leaf.astype(jnp.float32))
+                unit_dim = self._structured_unit_dim(kind, leaf)
+                width = leaf.shape[unit_dim]
+                # sum |w| over every non-unit dim EXCEPT a leading stacked-
+                # layer dim (masks are per layer row).  'channel' targets
+                # conv kernels [h, w, cin, cout] whose leading dims are
+                # spatial, not a layer stack — reduce them all.
+                keep_layer_dim = kind != "channel" and leaf.ndim >= 3
+                reduce_dims = tuple(
+                    d for d in range(leaf.ndim)
+                    if d != unit_dim and not (d == 0 and keep_layer_dim)
+                )
+                s = jnp.sum(x, axis=reduce_dims)  # [L?, width]
+                if kind == "head":
+                    if width % num_heads:
+                        raise ValueError(
+                            f"head_pruning: width {width} of '{p}' not "
+                            f"divisible by num_heads {num_heads}"
+                        )
+                    s = s.reshape(s.shape[:-1] + (num_heads, width // num_heads)).sum(-1)
+                scores = s if scores is None else scores + s
+            units = scores.shape[-1]
+            mask = structured_keep_mask(scores, dense_ratio)  # [L?, units]
+            for p, leaf in matched:
+                out[p] = (mask, self._structured_unit_dim(kind, leaf), units)
+            for p, leaf in flat.items():
+                if leaf.ndim >= 2 and any(
+                    re.search(rx, p) for rx in g.related_modules
+                ):
+                    # related module consumes the pruned units on its INPUT
+                    # dim (second-to-last in [..., in, out] layout)
+                    out[p] = (mask, leaf.ndim - 2, units)
+        return out
+
+    def _apply_structured(self, leaf, mask_info, step, offset):
+        mask, axis, units = mask_info
+        width = leaf.shape[axis]
+        per_unit = width // units
+        m = jnp.repeat(mask, per_unit, axis=-1)  # [L?, width]
+        shape = [1] * leaf.ndim
+        shape[axis] = width
+        if m.ndim == 2:  # stacked layers: leading L broadcast dim
+            shape[0] = leaf.shape[0]
+            m = m.reshape((leaf.shape[0],) + tuple(shape[1:]))
+        else:
+            m = m.reshape(shape)
+        pruned = leaf * m.astype(leaf.dtype)
+        active = step >= offset
+        return _ste(leaf, jnp.where(active, pruned, leaf))
 
     # -- the traced transform ------------------------------------------------
     def transform(self, params, step: jnp.ndarray):
@@ -192,6 +307,13 @@ class CompressionManager:
         if not self.any_weight_transform:
             return params
         flat = _flatten_with_paths(params)
+        structured: List[Tuple[Dict, int]] = []
+        for kind, tech in self._structured:
+            if tech.enabled and tech.groups:
+                structured.append((
+                    self._structured_masks(kind, tech, flat),
+                    int(tech.shared.get("schedule_offset", 0)),
+                ))
         out = {}
         for path, leaf in flat.items():
             new = leaf
@@ -203,6 +325,9 @@ class CompressionManager:
                 g = self.pruning.group_for(path)
                 if g is not None:
                     new = self._apply_prune(new, g, step)
+            for masks, offset in structured:
+                if path in masks:
+                    new = self._apply_structured(new, masks[path], step, offset)
             out[path] = new
         return _unflatten_with_paths(params, out)
 
@@ -240,6 +365,122 @@ class CompressionManager:
         (reference ``redundancy_clean``/fix-compression path)."""
         step_arr = jnp.asarray(10**9 if step is None else step, jnp.int32)
         return jax.jit(lambda p: self.transform(p, step_arr))(params)
+
+    def redundancy_clean(self, params):
+        """Physically shrink the tree: structured-pruned units (rows /
+        heads / channels) are REMOVED, not just masked — output dims of
+        matched modules and input dims of related modules drop to the kept
+        width (reference ``compress.py:148 redundancy_clean``).  Returns
+        ``(clean_params, info)`` where ``info[group_name]`` records the kept
+        unit indices per layer.  The dense_ratio keeps the same unit count
+        in every layer row, so stacked layers stay rectangular.
+
+        Unstructured (element-mask) pruning and QAT quantization are
+        hard-applied first via ``export_params`` — they do not change
+        shapes.
+        """
+        import numpy as np
+
+        params = self.export_params(params)
+        flat = _flatten_with_paths(params)
+        flat = {p: np.asarray(jax.device_get(v)) for p, v in flat.items()}
+        info: Dict[str, Any] = {}
+        for kind, tech in self._structured:
+            if not (tech.enabled and tech.groups):
+                continue
+            masks = self._structured_masks(
+                kind, tech,
+                {p: jnp.asarray(v) for p, v in flat.items()},
+            )
+            for path, (mask, axis, units) in masks.items():
+                leaf = flat[path]
+                m = np.asarray(jax.device_get(mask))  # [L?, units] bool
+                width = leaf.shape[axis]
+                per_unit = width // units
+                if m.ndim == 1:
+                    keep = np.where(np.repeat(m, per_unit))[0]
+                    flat[path] = np.take(leaf, keep, axis=axis)
+                else:  # per-layer kept indices; equal count per row
+                    rows = []
+                    for li in range(m.shape[0]):
+                        keep = np.where(np.repeat(m[li], per_unit))[0]
+                        rows.append(np.take(leaf[li], keep, axis=axis - 1))
+                    flat[path] = np.stack(rows)
+                info.setdefault(kind, {})[path] = {
+                    "kept_units": int(m.sum(-1).min()),
+                    "of": units,
+                }
+        clean = _unflatten_with_paths(
+            params, {p: jnp.asarray(v) for p, v in flat.items()}
+        )
+        return clean, info
+
+
+# ---------------------------------------------------------------------------
+# layer reduction + knowledge distillation (reference compress.py layer_
+# reduction + student init; helper.py student_initialization)
+# ---------------------------------------------------------------------------
+def layer_reduction_init(teacher_params, layer_reduction: Dict[str, Any]):
+    """Initialize a student tree from a teacher: layer-stacked leaves
+    (leading dim = layer) are indexed at ``teacher_layer``; everything else
+    is shared as-is.
+
+    Schema (reference constants.py:27, e.g.):
+        {"enabled": true, "keep_number_layer": 4,
+         "teacher_layer": [1, 3, 5, 7], "module_name_prefix": "layers"}
+    """
+    ids = list(layer_reduction.get("teacher_layer", []))
+    keep = layer_reduction.get("keep_number_layer", len(ids))
+    if not ids:
+        raise ValueError("layer_reduction.teacher_layer is required")
+    if keep != len(ids):
+        raise ValueError(
+            f"keep_number_layer {keep} != len(teacher_layer) {len(ids)}"
+        )
+    prefix = layer_reduction.get("module_name_prefix", "layers")
+    idx = jnp.asarray(ids, jnp.int32)
+    flat = _flatten_with_paths(teacher_params)
+    out = {}
+    for path, leaf in flat.items():
+        if path.startswith(prefix + "/") or path == prefix:
+            out[path] = jnp.take(leaf, idx, axis=0)
+        else:
+            out[path] = leaf
+    return _unflatten_with_paths(teacher_params, out)
+
+
+def kd_loss(student_logits, teacher_logits, temperature: float = 1.0):
+    """Soft-target KL distillation term (the loss the reference's
+    layer-reduction recipes pair with the task loss)."""
+    t = float(temperature)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    p = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    return -(t * t) * jnp.mean(jnp.sum(p * s, axis=-1))
+
+
+def make_kd_loss_fn(
+    student_model,
+    teacher_model,
+    teacher_params,
+    alpha: float = 0.5,
+    temperature: float = 2.0,
+):
+    """Engine-ready ``loss_fn(params, batch, rng)`` distilling
+    ``teacher_model(teacher_params)`` into the student: task loss blended
+    with the KD term.  The teacher forward runs under ``stop_gradient``
+    inside the same jitted step (no second engine needed)."""
+    from ..models.transformer import forward
+
+    t_params = jax.tree_util.tree_map(jax.lax.stop_gradient, teacher_params)
+
+    def loss_fn(params, batch, rng=None):
+        task = student_model.loss_fn(params, batch, rng)
+        s_logits, _, _ = forward(params, batch["input_ids"], student_model.cfg)
+        t_logits, _, _ = forward(t_params, batch["input_ids"], teacher_model.cfg)
+        kd = kd_loss(s_logits, jax.lax.stop_gradient(t_logits), temperature)
+        return (1.0 - alpha) * task + alpha * kd
+
+    return loss_fn
 
 
 def _flatten_with_paths(tree) -> Dict[str, Any]:

@@ -270,6 +270,30 @@ class CompressionConfig:
     weight_quantization: Dict[str, Any] = field(default_factory=dict)
     activation_quantization: Dict[str, Any] = field(default_factory=dict)
     sparse_pruning: Dict[str, Any] = field(default_factory=dict)
+    # structured compression (reference compression/constants.py:137-180, :27)
+    row_pruning: Dict[str, Any] = field(default_factory=dict)
+    head_pruning: Dict[str, Any] = field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "weight_quantization": self.weight_quantization,
+            "activation_quantization": self.activation_quantization,
+            "sparse_pruning": self.sparse_pruning,
+            "row_pruning": self.row_pruning,
+            "head_pruning": self.head_pruning,
+            "channel_pruning": self.channel_pruning,
+            "layer_reduction": self.layer_reduction,
+        }
+
+    @property
+    def any_technique(self) -> bool:
+        return bool(
+            self.weight_quantization or self.activation_quantization
+            or self.sparse_pruning or self.row_pruning or self.head_pruning
+            or self.channel_pruning
+        )
 
 
 @dataclass

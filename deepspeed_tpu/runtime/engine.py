@@ -301,14 +301,10 @@ class DeepSpeedTpuEngine:
         # sampler position rides engine checkpoints (checkpoint/saving.py)
         self._compression = None
         cc = config.compression_training
-        if cc.weight_quantization or cc.activation_quantization or cc.sparse_pruning:
+        if cc.any_technique:
             from ..compression.compress import CompressionManager
 
-            manager = CompressionManager({
-                "weight_quantization": cc.weight_quantization,
-                "activation_quantization": cc.activation_quantization,
-                "sparse_pruning": cc.sparse_pruning,
-            })
+            manager = CompressionManager(cc.as_dict())
             if manager.any_weight_transform:
                 if self._onebit or self._zeropp_vag is not None:
                     from ..config.config import ConfigError

@@ -223,3 +223,179 @@ def test_init_compression_on_engine():
     rng = np.random.default_rng(9)
     batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 33)).astype(np.int32)}
     assert np.isfinite(float(engine.train_batch(batch)))
+
+
+# ---------------------------------------------------------------------------
+# structured compression (r4 VERDICT next #4: head/row/channel pruning,
+# layer reduction, distillation; reference basic_layer.py + compress.py:148)
+# ---------------------------------------------------------------------------
+def _tiny_params_and_cfg():
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    model = CausalLM(cfg)
+    return model, cfg, model.init_params(jax.random.PRNGKey(0))
+
+
+def test_row_pruning_masks_mlp_consistently():
+    model, cfg, params = _tiny_params_and_cfg()
+    mgr = CompressionManager({
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "method": "l1",
+                                  "schedule_offset": 0},
+            "different_groups": {"rp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": [r"layers/mlp/w_(up|gate)$"],
+                "related_modules": [[r"layers/mlp/w_down$"]],
+            }},
+        }
+    })
+    out = mgr.transform(params, jnp.asarray(10, jnp.int32))
+    w_up = np.asarray(out["layers"]["mlp"]["w_up"], np.float32)
+    w_down = np.asarray(out["layers"]["mlp"]["w_down"], np.float32)
+    L, d, ffn = w_up.shape
+    dead_up = np.all(w_up == 0, axis=1)       # [L, ffn] col dead
+    dead_down = np.all(w_down == 0, axis=2)   # [L, ffn] row dead
+    assert dead_up.sum(-1).tolist() == [ffn // 2] * L
+    # the SAME units die in the consumer (related module)
+    np.testing.assert_array_equal(dead_up, dead_down)
+    # and the gated twin
+    w_gate = np.asarray(out["layers"]["mlp"]["w_gate"], np.float32)
+    np.testing.assert_array_equal(np.all(w_gate == 0, axis=1), dead_up)
+
+
+def test_head_pruning_masks_whole_heads():
+    model, cfg, params = _tiny_params_and_cfg()
+    mgr = CompressionManager({
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "num_heads": cfg.num_heads,
+                                  "schedule_offset": 0},
+            "different_groups": {"hp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": [r"layers/attn/wq$"],
+                "related_modules": [[r"layers/attn/wo$"]],
+            }},
+        }
+    })
+    out = mgr.transform(params, jnp.asarray(10, jnp.int32))
+    hd = cfg.hd
+    wq = np.asarray(out["layers"]["attn"]["wq"], np.float32)
+    wo = np.asarray(out["layers"]["attn"]["wo"], np.float32)
+    L = wq.shape[0]
+    per_head_dead_q = np.all(
+        wq.reshape(L, wq.shape[1], cfg.num_heads, hd) == 0, axis=(1, 3)
+    )  # [L, H]
+    per_head_dead_o = np.all(
+        wo.reshape(L, cfg.num_heads, hd, wo.shape[-1]) == 0, axis=(2, 3)
+    )
+    assert per_head_dead_q.sum(-1).tolist() == [cfg.num_heads // 2] * L
+    np.testing.assert_array_equal(per_head_dead_q, per_head_dead_o)
+
+
+def test_redundancy_clean_exports_shrunk_tree_same_loss():
+    """Masked model and physically-shrunk model must compute the SAME loss
+    (the dead units contribute exactly zero), with smaller arrays."""
+    from deepspeed_tpu.models import CausalLM
+
+    model, cfg, params = _tiny_params_and_cfg()
+    mgr = CompressionManager({
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"rp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": [r"layers/mlp/w_(up|gate)$"],
+                "related_modules": [[r"layers/mlp/w_down$"]],
+            }},
+        }
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (4, 33)).astype(np.int32)}
+    masked = mgr.export_params(params)
+    clean, info = mgr.redundancy_clean(params)
+    ffn = params["layers"]["mlp"]["w_up"].shape[-1]
+    assert clean["layers"]["mlp"]["w_up"].shape[-1] == ffn // 2
+    assert clean["layers"]["mlp"]["w_down"].shape[-2] == ffn // 2
+    assert info["row"]
+    l_masked = float(jax.jit(model.loss_fn)(masked, batch))
+    l_clean = float(jax.jit(model.loss_fn)(clean, batch))
+    assert abs(l_masked - l_clean) < 2e-3, (l_masked, l_clean)
+
+
+def test_head_pruning_trains_and_recovers():
+    """e2e 'done' criterion: prune half the proxy's heads mid-training and
+    keep training — loss recovers to a decreasing trajectory."""
+    from deepspeed_tpu.models import get_preset
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    losses = _train({
+        "compression_training": {
+            "head_pruning": {
+                "shared_parameters": {"enabled": True,
+                                      "num_heads": cfg.num_heads,
+                                      "schedule_offset": 10},
+                "different_groups": {"hp1": {
+                    "params": {"dense_ratio": 0.5},
+                    "modules": [r"layers/attn/wq$"],
+                    "related_modules": [[r"layers/attn/wo$"]],
+                }},
+            }
+        }
+    }, steps=30)
+    assert np.isfinite(losses).all()
+    # pruning kicks in at step 10; by the end training has recovered
+    assert losses[-1] < losses[9], (losses[9], losses[-1])
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_layer_reduction_and_kd():
+    from deepspeed_tpu.compression import layer_reduction_init, make_kd_loss_fn
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    t_cfg = get_preset("tiny", max_seq_len=32, num_layers=4)
+    teacher = CausalLM(t_cfg)
+    t_params = teacher.init_params(jax.random.PRNGKey(0))
+    student_params = layer_reduction_init(
+        t_params,
+        {"enabled": True, "keep_number_layer": 2, "teacher_layer": [1, 3],
+         "module_name_prefix": "layers"},
+    )
+    assert student_params["layers"]["mlp"]["w_up"].shape[0] == 2
+    np.testing.assert_array_equal(
+        np.asarray(student_params["layers"]["mlp"]["w_up"][0], np.float32),
+        np.asarray(t_params["layers"]["mlp"]["w_up"][1], np.float32),
+    )
+    s_cfg = t_cfg.replace(num_layers=2)
+    student = CausalLM(s_cfg)
+    loss_fn = make_kd_loss_fn(student, teacher, t_params, alpha=0.5, temperature=2.0)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, params=student_params,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 0},
+        },
+        mesh=deepspeed_tpu.initialize_mesh(data=8),
+    )
+    rng = np.random.default_rng(7)
+    batch = {"input_ids": rng.integers(0, t_cfg.vocab_size, (16, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(30)]
+    assert np.isfinite(losses).all()
+    # the KD KL term carries a T^2=4 scale, so the blended loss falls more
+    # slowly than a pure task loss — assert a solid decrease, not a halving
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_init_compression_accepts_full_reference_schema():
+    mgr = CompressionManager({
+        "weight_quantization": {"shared_parameters": {"enabled": True},
+                                "different_groups": {}},
+        "activation_quantization": {"shared_parameters": {"enabled": False}},
+        "sparse_pruning": {"shared_parameters": {"enabled": False}},
+        "row_pruning": {"shared_parameters": {"enabled": False}},
+        "head_pruning": {"shared_parameters": {"enabled": False}},
+        "channel_pruning": {"shared_parameters": {"enabled": False}},
+        "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                            "teacher_layer": [0, 1]},
+    })
+    assert mgr.layer_reduction["keep_number_layer"] == 2
+    assert not mgr.any_weight_transform  # only disabled techniques
