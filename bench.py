@@ -97,10 +97,11 @@ def main():
     }))
 
 
-def serving_main():
+def serving_main(quant=None):
     """Serving throughput: continuous-batching decode at batch 64 on one
-    chip (`python bench.py --serving`).  Prints one JSON line; not the
-    driver's flagship metric — the serving counterpart for the README."""
+    chip (`python bench.py --serving [--quant int8|fp8]`).  Prints one JSON
+    line; not the driver's flagship metric — the serving counterpart for
+    the README."""
     from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.inference.sampling import SamplingParams
     from deepspeed_tpu.models import get_preset
@@ -116,7 +117,7 @@ def serving_main():
     params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.bfloat16)
     eng = InferenceEngineV2(
         params, cfg, max_seqs=B, num_blocks=blocks, block_size=32,
-        prefill_budget=2048,
+        prefill_budget=2048, quantize_weights=quant,
     )
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(B)]
@@ -145,8 +146,11 @@ def serving_main():
     eng.step_n(decode_steps, samp)
     burst_dt = time.perf_counter() - t0
     decode_tok_s = B * decode_steps / burst_dt
+    metric = "serve_decode_tokens_per_sec_llama3arch_410m_batch64"
+    if quant:
+        metric += f"_{quant}"
     print(json.dumps({
-        "metric": "serve_decode_tokens_per_sec_llama3arch_410m_batch64",
+        "metric": metric,
         "value": round(decode_tok_s, 1),
         "unit": "tokens/s",
         "extra": {
@@ -154,7 +158,199 @@ def serving_main():
             "ms_per_tick_pipelined": round(1e3 * burst_dt / decode_steps, 2),
             "ms_per_tick_synchronous": round(1e3 * tick_dt, 2),
             "prefill_tokens_per_sec": round(B * prompt_len / prefill_dt, 1),
-            "params": cfg.param_count,
+            "params": cfg.param_count, "quantize_weights": quant,
+        },
+    }))
+
+
+def offload_main():
+    """ZeRO-3-Offload proof (`python bench.py --offload`), two measurements:
+
+    1. HOST PIPELINE AT SCALE — a 1B-param pipelined NVMe AdamW walk
+       (C++ AIO engine + fused host Adam, fp32 master/m/v on local SSD):
+       the subsystem the reference's 50-TFLOPS/GPU ZeRO-3-Offload number
+       rides on (docs/_posts/2021-03-08-zero3-offload.md:65).
+    2. END-TO-END ON THE CHIP — the full pipelined-DPU training loop
+       (device grads -> D2H -> host walk -> H2D) at whatever scale the
+       host<->device link affords; on the axon-tunneled dev chip that link
+       measures ~7 MiB/s H2D / ~0.6 MiB/s D2H (vs 16-64 GB/s on real
+       TPU-VM PCIe), so the e2e model is small and the RATE evidence is
+       measurement 1 + the link numbers, reported together.
+    """
+    import os
+    import shutil
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import CausalLM, get_preset
+    from deepspeed_tpu.runtime.offload import NVMeOptimizer
+
+    # --- 1) host pipeline at 1B-param scale (no device involved) ---------
+    swap_dir = "/tmp/dstpu_offload_bench"
+    shutil.rmtree(swap_dir, ignore_errors=True)
+    n_big = 1_000_000_000 if jax.devices()[0].platform == "tpu" else 2_000_000
+    leaf = 25_000_000 if n_big > 10_000_000 else 500_000
+    tree = {
+        f"w{i}": np.zeros((leaf,), np.float32) for i in range(n_big // leaf)
+    }
+    opt = NVMeOptimizer(swap_dir, lr=1e-4, num_threads=8, queue_depth=32)
+    t0 = time.perf_counter()
+    opt.init(tree)
+    init_s = time.perf_counter() - t0
+    grads = {k: np.full((leaf,), 1e-3, np.float32) for k in tree}
+    walk_s = float("inf")
+    for s in range(2):
+        t0 = time.perf_counter()
+        opt.step(grads, lr=1e-4, step_num=s + 1, on_leaf=lambda i, m: None)
+        walk_s = min(walk_s, time.perf_counter() - t0)
+    opt.close()
+    shutil.rmtree(swap_dir, ignore_errors=True)
+    state_gb = n_big * 12 / 1e9  # fp32 master + m + v
+    # bytes actually moved per walk: read master+m+v (+grad in RAM), write
+    # master+m+v back
+    moved_gb = n_big * 24 / 1e9
+    walk_gbps = moved_gb / walk_s
+
+    # --- 2) end-to-end pipelined DPU on the live backend -----------------
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # ~4M params: the largest the ~0.6 MiB/s tunnel D2H turns around in
+        # a tolerable step (bf16 grads ~8 MiB)
+        cfg = get_preset("tiny", max_seq_len=1024).replace(
+            hidden_size=256, num_layers=4, num_heads=4, num_kv_heads=4,
+            attn_impl="reference",
+        )
+        micro, seq, steps, gas = 2, 1024, 2, 1
+    else:
+        cfg = get_preset("tiny", max_seq_len=256)
+        micro, seq, steps, gas = 2, 256, 2, 1
+    model = CausalLM(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {
+                "stage": 3, "param_persistence_threshold": 0,
+                "offload_optimizer": "nvme",
+                "offload_nvme_path": "/tmp/dstpu_offload_e2e",
+                "offload_pipeline": True,
+                "offload_grad_dtype": "bf16",
+            },
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**6,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (gas, micro, seq + 1), dtype=np.int64)}
+    float(engine.train_batch(batch))  # compile + first (unpipelined) walk
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    engine.flush_nvme_pipeline()
+    float(loss)
+    e2e_dt = (time.perf_counter() - t0) / steps
+    # overlap fraction: walk time hidden behind the device/link work
+    span = engine._nvme_walk_span
+    walk_e2e = (span[1] - span[0]) if span else 0.0
+    overlap = max(0.0, min(1.0, walk_e2e / e2e_dt)) if e2e_dt else 0.0
+    tok_s = gas * micro * seq / e2e_dt
+
+    print(json.dumps({
+        "metric": "offload_host_optimizer_walk_gb_per_sec_1b_params",
+        "value": round(walk_gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": None,
+        "extra": {
+            "host_walk_params": n_big,
+            "host_state_gb": round(state_gb, 1),
+            "host_walk_s": round(walk_s, 1),
+            "host_init_s": round(init_s, 1),
+            "e2e_params": model.param_count,
+            "e2e_tokens_per_sec": round(tok_s, 1),
+            "e2e_step_s": round(e2e_dt, 2),
+            "e2e_walk_hidden_fraction": round(overlap, 3),
+            "grad_wire_dtype": "bf16",
+            "note": "dev-chip host link ~7MiB/s H2D, ~0.6MiB/s D2H via axon "
+                    "tunnel; see README Offload section for the projection "
+                    "against the reference's 50 TFLOPS/GPU ZeRO-3-Offload",
+        },
+    }))
+
+
+def longctx_main():
+    """Long-context single-chip proof (`python bench.py --longctx`): one
+    training step at seq >= 128k with flash attention + selective remat +
+    chunked CE (tokens/s + compiled memory).  Ring attention is the
+    multi-chip long-context mechanism (dryrun case 'zero3 x ring'); one
+    chip exercises the kernel/remat/loss machinery the ring composes with.
+    """
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        seq = 131_072
+        cfg = get_preset("tiny", max_seq_len=seq).replace(
+            hidden_size=512, num_layers=4, num_heads=8, num_kv_heads=8,
+            vocab_size=8192, remat="selective", loss_chunk_size=8192,
+        )
+        steps = 2
+    else:
+        seq = 2048
+        cfg = get_preset("tiny", max_seq_len=seq).replace(
+            remat="selective", loss_chunk_size=512
+        )
+        steps = 1
+    model = CausalLM(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 0},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**6,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (1, 1, seq + 1), dtype=np.int64)}
+    float(engine.train_batch(batch))
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch)
+        float(loss)
+        dt = min(dt, (time.perf_counter() - t0) / steps)
+    # compiled memory footprint (device allocator stats are unavailable
+    # through the tunnel; the compiler's own accounting is exact).  The
+    # second lower/compile hits the XLA compilation cache.
+    mem = {}
+    try:
+        step = engine._get_train_step(batch)
+        m = step.lower(engine.state, batch, engine._rng).compile().memory_analysis()
+        mem = {
+            "argument_gb": round(m.argument_size_in_bytes / 1e9, 2),
+            "output_gb": round(m.output_size_in_bytes / 1e9, 2),
+            "temp_gb": round(m.temp_size_in_bytes / 1e9, 2),
+            "peak_gb": round(
+                (m.argument_size_in_bytes + m.output_size_in_bytes
+                 + m.temp_size_in_bytes) / 1e9, 2),
+        }
+    except Exception:
+        pass
+    tok_s = seq / dt
+    print(json.dumps({
+        "metric": f"train_tokens_per_sec_seq{seq // 1024}k_single_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {
+            "seq": seq, "params": model.param_count,
+            "step_time_s": round(dt, 2), "loss": float(loss),
+            "remat": "selective", "loss_chunk": cfg.loss_chunk_size,
+            "compiled_memory": mem,
         },
     }))
 
@@ -163,6 +359,13 @@ if __name__ == "__main__":
     import sys
 
     if "--serving" in sys.argv:
-        serving_main()
+        q = None
+        if "--quant" in sys.argv:
+            q = sys.argv[sys.argv.index("--quant") + 1]
+        serving_main(quant=q)
+    elif "--offload" in sys.argv:
+        offload_main()
+    elif "--longctx" in sys.argv:
+        longctx_main()
     else:
         main()

@@ -9,12 +9,18 @@ with torch's ``[out, in]`` Linear layout transposed to our ``x @ W``
 ``[in, out]`` kernels and per-layer tensors stacked into the leading ``L``
 dimension the scanned decoder expects.
 
-RoPE needs no permutation: both HF Llama and ``models/transformer.py:193``
-use the half-split (NeoX) rotation.
+RoPE needs no permutation for the Llama families: both HF Llama and
+``models/transformer.py`` use the half-split (NeoX) rotation.  GPT-J uses
+the interleaved rotation — its rotary columns are permuted to half-split at
+import (the inverse of the permutation HF applies converting Llama weights).
 
-Supported families: llama/llama2/llama3, mistral, qwen2 (attention bias),
-mixtral (MoE experts), gpt2-style learned-position models are *not* mapped
-here (their HF layout differs; use presets + own checkpoints).
+Supported families (reference: module_inject/containers/ 20 policy files +
+inference/v2/model_implementations 10 families):
+llama/llama2/llama3, mistral, qwen2, mixtral (MoE), gpt2 (learned pos,
+Conv1D fused qkv), opt (learned pos offset-2, ReLU), bloom (ALiBi, fused
+per-head qkv, embedding LN), falcon (parallel block, MQA fused qkv),
+gptj (parallel block, partial interleaved rotary), phi (parallel block,
+partial rotary, biases).
 """
 from __future__ import annotations
 
@@ -35,6 +41,97 @@ Params = Any
 def config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
     """Map an HF ``config.json`` dict to a TransformerConfig."""
     model_type = hf.get("model_type", "llama")
+    if model_type in ("gpt2", "gptj"):
+        # GPT-2-lineage configs use n_embd/n_head/n_layer names
+        d, heads, L = hf["n_embd"], hf["n_head"], hf["n_layer"]
+        kw = dict(
+            vocab_size=hf["vocab_size"], hidden_size=d,
+            intermediate_size=hf.get("n_inner") or 4 * d,
+            num_layers=L, num_heads=heads, num_kv_heads=heads,
+            max_seq_len=hf.get("n_positions", 2048),
+            norm="layernorm", activation="gelu", gated_mlp=False,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        )
+        if model_type == "gpt2":
+            kw.update(position="learned", tie_embeddings=True,
+                      qkv_bias=True, attn_out_bias=True, mlp_bias=True)
+        else:  # gptj
+            kw.update(position="rope", parallel_block=True, mlp_bias=True,
+                      rotary_dim=hf.get("rotary_dim", 64),
+                      rope_theta=10_000.0, tie_embeddings=False,
+                      head_bias=True)
+        return TransformerConfig(**kw)
+    if model_type == "opt":
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+            intermediate_size=hf["ffn_dim"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            position="learned", norm="layernorm",
+            activation={"relu": "relu", "gelu": "gelu"}.get(
+                hf.get("activation_function", "relu"), "relu"),
+            gated_mlp=False, qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            norm_eps=1e-5,
+        )
+    if model_type == "bloom":
+        d = hf["hidden_size"]
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], hidden_size=d,
+            intermediate_size=4 * d,
+            num_layers=hf.get("n_layer", hf.get("num_hidden_layers")),
+            num_heads=hf.get("n_head", hf.get("num_attention_heads")),
+            num_kv_heads=hf.get("n_head", hf.get("num_attention_heads")),
+            max_seq_len=hf.get("seq_length", 2048), position="alibi",
+            norm="layernorm", activation="gelu", gated_mlp=False,
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            embedding_norm=True, tie_embeddings=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            attn_impl="reference",
+        )
+    if model_type == "falcon":
+        d = hf["hidden_size"]
+        heads = hf.get("num_attention_heads", hf.get("n_head"))
+        kv = heads if not hf.get("multi_query", False) else 1
+        if hf.get("new_decoder_architecture"):
+            kv = hf.get("num_kv_heads", kv)
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], hidden_size=d,
+            intermediate_size=hf.get("ffn_hidden_size", 4 * d),
+            num_layers=hf.get("num_hidden_layers", hf.get("n_layer")),
+            num_heads=heads, num_kv_heads=kv, head_dim=d // heads,
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", gated_mlp=False,
+            parallel_block=bool(hf.get("parallel_attn", True)),
+            qkv_bias=bool(hf.get("bias", False)),
+            attn_out_bias=bool(hf.get("bias", False)),
+            mlp_bias=bool(hf.get("bias", False)),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            rope_theta=hf.get("rope_theta", 10_000.0),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        )
+    if model_type == "phi":
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads")
+            or hf["num_attention_heads"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", gated_mlp=False,
+            parallel_block=True, qkv_bias=True, attn_out_bias=True,
+            mlp_bias=True, head_bias=True,
+            rotary_dim=int(
+                hf.get("partial_rotary_factor", 0.5)
+                * (hf["hidden_size"] // hf["num_attention_heads"])
+            ),
+            rope_theta=hf.get("rope_theta", 10_000.0),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+        )
     kw: Dict[str, Any] = dict(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
@@ -58,6 +155,274 @@ def config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
         kw["moe_num_experts"] = hf.get("num_local_experts", 0)
         kw["moe_top_k"] = hf.get("num_experts_per_tok", 2)
     return TransformerConfig(**kw)
+
+
+def _interleaved_to_half(w: np.ndarray, heads: int, hd: int, rot: int) -> np.ndarray:
+    """Permute the rotary columns of a ``[.., heads*hd]`` projection from
+    GPT-J's interleaved pair layout to the half-split layout our ``rope``
+    implements: half pair (i, i+rot/2) <- interleaved pair (2i, 2i+1)."""
+    w = w.reshape(w.shape[:-1] + (heads, hd))
+    perm = np.concatenate([np.arange(0, rot, 2), np.arange(1, rot, 2)])
+    rotary = w[..., :rot][..., perm]
+    w = np.concatenate([rotary, w[..., rot:]], axis=-1)
+    return w.reshape(w.shape[:-2] + (heads * hd,))
+
+
+def _load_family_layers(t, cfg, model_type: str):
+    """Per-family tensor-name tables -> the init_params layer tree.
+    Returns (params, leftovers_consumed_ok).  All torch Linears transpose to
+    ``[in, out]``; gpt2 Conv1D is already ``[in, out]``."""
+    L = cfg.num_layers
+    d = cfg.hidden_size
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+
+    def take(name):
+        if name not in t:
+            raise KeyError(f"missing tensor {name!r}")
+        return t.pop(name)
+
+    def stack(fmt, transpose=True):
+        ws = [take(fmt.format(i=i)) for i in range(L)]
+        return np.stack([w.T if transpose else w for w in ws])
+
+    if model_type == "gpt2":
+        # Conv1D [in, out]; c_attn fuses qkv on the output dim
+        qkv_w = stack("transformer.h.{i}.attn.c_attn.weight", transpose=False)
+        qkv_b = stack("transformer.h.{i}.attn.c_attn.bias", transpose=False)
+        wq, wk, wv = np.split(qkv_w, 3, axis=-1)
+        bq, bk, bv = np.split(qkv_b, 3, axis=-1)
+        layers = {
+            "attn": {
+                "wq": wq, "wk": wk, "wv": wv,
+                "bq": bq, "bk": bk, "bv": bv,
+                "wo": stack("transformer.h.{i}.attn.c_proj.weight", transpose=False),
+                "bo": stack("transformer.h.{i}.attn.c_proj.bias", transpose=False),
+            },
+            "attn_norm": {
+                "scale": stack("transformer.h.{i}.ln_1.weight", transpose=False),
+                "bias": stack("transformer.h.{i}.ln_1.bias", transpose=False),
+            },
+            "mlp_norm": {
+                "scale": stack("transformer.h.{i}.ln_2.weight", transpose=False),
+                "bias": stack("transformer.h.{i}.ln_2.bias", transpose=False),
+            },
+            "mlp": {
+                "w_up": stack("transformer.h.{i}.mlp.c_fc.weight", transpose=False),
+                "b_up": stack("transformer.h.{i}.mlp.c_fc.bias", transpose=False),
+                "w_down": stack("transformer.h.{i}.mlp.c_proj.weight", transpose=False),
+                "b_down": stack("transformer.h.{i}.mlp.c_proj.bias", transpose=False),
+            },
+        }
+        params = {
+            "embed": {"embedding": take("transformer.wte.weight")},
+            "pos_embed": {"embedding": take("transformer.wpe.weight")},
+            "layers": layers,
+            "final_norm": {
+                "scale": take("transformer.ln_f.weight"),
+                "bias": take("transformer.ln_f.bias"),
+            },
+        }
+        return params
+
+    if model_type == "opt":
+        p = "model.decoder.layers.{i}."
+        layers = {
+            "attn": {
+                "wq": stack(p + "self_attn.q_proj.weight"),
+                "wk": stack(p + "self_attn.k_proj.weight"),
+                "wv": stack(p + "self_attn.v_proj.weight"),
+                "wo": stack(p + "self_attn.out_proj.weight"),
+                "bq": stack(p + "self_attn.q_proj.bias", transpose=False),
+                "bk": stack(p + "self_attn.k_proj.bias", transpose=False),
+                "bv": stack(p + "self_attn.v_proj.bias", transpose=False),
+                "bo": stack(p + "self_attn.out_proj.bias", transpose=False),
+            },
+            "attn_norm": {
+                "scale": stack(p + "self_attn_layer_norm.weight", transpose=False),
+                "bias": stack(p + "self_attn_layer_norm.bias", transpose=False),
+            },
+            "mlp_norm": {
+                "scale": stack(p + "final_layer_norm.weight", transpose=False),
+                "bias": stack(p + "final_layer_norm.bias", transpose=False),
+            },
+            "mlp": {
+                "w_up": stack(p + "fc1.weight"),
+                "b_up": stack(p + "fc1.bias", transpose=False),
+                "w_down": stack(p + "fc2.weight"),
+                "b_down": stack(p + "fc2.bias", transpose=False),
+            },
+        }
+        # HF OPT offsets learned positions by 2 (padding-idx legacy): rows
+        # [2:] are the real table for positions 0..max-1
+        wpe = take("model.decoder.embed_positions.weight")[2:]
+        params = {
+            "embed": {"embedding": take("model.decoder.embed_tokens.weight")},
+            "pos_embed": {"embedding": wpe},
+            "layers": layers,
+            "final_norm": {
+                "scale": take("model.decoder.final_layer_norm.weight"),
+                "bias": take("model.decoder.final_layer_norm.bias"),
+            },
+        }
+        return params
+
+    if model_type == "bloom":
+        p = "transformer.h.{i}."
+        # fused qkv, PER-HEAD interleaved: [heads, 3, hd] on the out dim
+        qkv_w = stack(p + "self_attention.query_key_value.weight")  # [L, d, 3*d]
+        qkv_b = stack(p + "self_attention.query_key_value.bias", transpose=False)
+        qkv_w = qkv_w.reshape(L, d, hq, 3, hd)
+        qkv_b = qkv_b.reshape(L, hq, 3, hd)
+        wq = qkv_w[:, :, :, 0].reshape(L, d, hq * hd)
+        wk = qkv_w[:, :, :, 1].reshape(L, d, hq * hd)
+        wv = qkv_w[:, :, :, 2].reshape(L, d, hq * hd)
+        bq = qkv_b[:, :, 0].reshape(L, hq * hd)
+        bk = qkv_b[:, :, 1].reshape(L, hq * hd)
+        bv = qkv_b[:, :, 2].reshape(L, hq * hd)
+        layers = {
+            "attn": {
+                "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+                "wo": stack(p + "self_attention.dense.weight"),
+                "bo": stack(p + "self_attention.dense.bias", transpose=False),
+            },
+            "attn_norm": {
+                "scale": stack(p + "input_layernorm.weight", transpose=False),
+                "bias": stack(p + "input_layernorm.bias", transpose=False),
+            },
+            "mlp_norm": {
+                "scale": stack(p + "post_attention_layernorm.weight", transpose=False),
+                "bias": stack(p + "post_attention_layernorm.bias", transpose=False),
+            },
+            "mlp": {
+                "w_up": stack(p + "mlp.dense_h_to_4h.weight"),
+                "b_up": stack(p + "mlp.dense_h_to_4h.bias", transpose=False),
+                "w_down": stack(p + "mlp.dense_4h_to_h.weight"),
+                "b_down": stack(p + "mlp.dense_4h_to_h.bias", transpose=False),
+            },
+        }
+        params = {
+            "embed": {"embedding": take("transformer.word_embeddings.weight")},
+            "embed_norm": {
+                "scale": take("transformer.word_embeddings_layernorm.weight"),
+                "bias": take("transformer.word_embeddings_layernorm.bias"),
+            },
+            "layers": layers,
+            "final_norm": {
+                "scale": take("transformer.ln_f.weight"),
+                "bias": take("transformer.ln_f.bias"),
+            },
+        }
+        return params
+
+    if model_type == "falcon":
+        p = "transformer.h.{i}."
+        # classic falcon (multi_query): fused [.., (heads+2)*hd] = q heads,
+        # then one k head, one v head
+        qkv_w = stack(p + "self_attention.query_key_value.weight")  # [L, d, (hq+2*hkv)*hd]
+        qkv_w = qkv_w.reshape(L, d, hq + 2 * hkv, hd)
+        wq = qkv_w[:, :, :hq].reshape(L, d, hq * hd)
+        wk = qkv_w[:, :, hq : hq + hkv].reshape(L, d, hkv * hd)
+        wv = qkv_w[:, :, hq + hkv :].reshape(L, d, hkv * hd)
+        layers = {
+            "attn": {
+                "wq": wq, "wk": wk, "wv": wv,
+                "wo": stack(p + "self_attention.dense.weight"),
+            },
+            "attn_norm": {
+                "scale": stack(p + "input_layernorm.weight", transpose=False),
+                "bias": stack(p + "input_layernorm.bias", transpose=False),
+            },
+            "mlp": {
+                "w_up": stack(p + "mlp.dense_h_to_4h.weight"),
+                "w_down": stack(p + "mlp.dense_4h_to_h.weight"),
+            },
+        }
+        if not cfg.parallel_block:
+            layers["mlp_norm"] = {
+                "scale": stack(p + "post_attention_layernorm.weight", transpose=False),
+                "bias": stack(p + "post_attention_layernorm.bias", transpose=False),
+            }
+        params = {
+            "embed": {"embedding": take("transformer.word_embeddings.weight")},
+            "layers": layers,
+            "final_norm": {
+                "scale": take("transformer.ln_f.weight"),
+                "bias": take("transformer.ln_f.bias"),
+            },
+        }
+        return params
+
+    if model_type == "gptj":
+        p = "transformer.h.{i}."
+        rot = cfg.rotary_dim or hd
+        wq = stack(p + "attn.q_proj.weight")
+        wk = stack(p + "attn.k_proj.weight")
+        layers = {
+            "attn": {
+                "wq": _interleaved_to_half(wq, hq, hd, rot),
+                "wk": _interleaved_to_half(wk, hkv, hd, rot),
+                "wv": stack(p + "attn.v_proj.weight"),
+                "wo": stack(p + "attn.out_proj.weight"),
+            },
+            "attn_norm": {
+                "scale": stack(p + "ln_1.weight", transpose=False),
+                "bias": stack(p + "ln_1.bias", transpose=False),
+            },
+            "mlp": {
+                "w_up": stack(p + "mlp.fc_in.weight"),
+                "b_up": stack(p + "mlp.fc_in.bias", transpose=False),
+                "w_down": stack(p + "mlp.fc_out.weight"),
+                "b_down": stack(p + "mlp.fc_out.bias", transpose=False),
+            },
+        }
+        params = {
+            "embed": {"embedding": take("transformer.wte.weight")},
+            "layers": layers,
+            "final_norm": {
+                "scale": take("transformer.ln_f.weight"),
+                "bias": take("transformer.ln_f.bias"),
+            },
+        }
+        return params
+
+    if model_type == "phi":
+        p = "model.layers.{i}."
+        layers = {
+            "attn": {
+                "wq": stack(p + "self_attn.q_proj.weight"),
+                "wk": stack(p + "self_attn.k_proj.weight"),
+                "wv": stack(p + "self_attn.v_proj.weight"),
+                "wo": stack(p + "self_attn.dense.weight"),
+                "bq": stack(p + "self_attn.q_proj.bias", transpose=False),
+                "bk": stack(p + "self_attn.k_proj.bias", transpose=False),
+                "bv": stack(p + "self_attn.v_proj.bias", transpose=False),
+                "bo": stack(p + "self_attn.dense.bias", transpose=False),
+            },
+            "attn_norm": {
+                "scale": stack(p + "input_layernorm.weight", transpose=False),
+                "bias": stack(p + "input_layernorm.bias", transpose=False),
+            },
+            "mlp": {
+                "w_up": stack(p + "mlp.fc1.weight"),
+                "b_up": stack(p + "mlp.fc1.bias", transpose=False),
+                "w_down": stack(p + "mlp.fc2.weight"),
+                "b_down": stack(p + "mlp.fc2.bias", transpose=False),
+            },
+        }
+        params = {
+            "embed": {"embedding": take("model.embed_tokens.weight")},
+            "layers": layers,
+            "final_norm": {
+                "scale": take("model.final_layernorm.weight"),
+                "bias": take("model.final_layernorm.bias"),
+            },
+        }
+        return params
+
+    raise KeyError(model_type)
+
+
+_FAMILY_LOADERS = ("gpt2", "opt", "bloom", "falcon", "gptj", "phi")
 
 
 def _read_tensors(model_dir: str) -> Dict[str, np.ndarray]:
@@ -104,6 +469,34 @@ def load_hf_checkpoint(
         cfg = config_from_hf(hf_cfg)
     t = _read_tensors(model_dir)
     L = cfg.num_layers
+
+    if hf_cfg.get("model_type") in _FAMILY_LOADERS:
+        params = _load_family_layers(t, cfg, hf_cfg["model_type"])
+        if not cfg.tie_embeddings:
+            if "lm_head.weight" in t:
+                params["lm_head"] = {"kernel": t.pop("lm_head.weight").T}
+                if cfg.head_bias and "lm_head.bias" in t:
+                    params["lm_head"]["bias"] = t.pop("lm_head.bias")
+            else:  # checkpoint ties even if config didn't say so
+                cfg = cfg.replace(tie_embeddings=True)
+        t.pop("lm_head.weight", None)
+        t.pop("lm_head.bias", None)
+        leftovers = [
+            k for k in t
+            if "rotary_emb" not in k and ".attn.bias" not in k
+            and ".attn.masked_bias" not in k
+        ]
+        if leftovers:
+            log_dist(
+                f"hf import: {len(leftovers)} unmapped tensors, e.g. {leftovers[:4]}"
+            )
+        params = jax.tree_util.tree_map(lambda x: _f(x, dtype), params)
+        n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+        log_dist(
+            f"hf import[{hf_cfg['model_type']}]: loaded {n/1e6:.1f}M params "
+            f"from {model_dir}"
+        )
+        return params, cfg
 
     def take(name: str) -> np.ndarray:
         if name not in t:

@@ -81,6 +81,10 @@ class ZeroConfig:
     # walk with step k+1's device grad computation (ZeRO-Offload's delayed
     # parameter update — one-step gradient staleness)
     offload_pipeline: bool = False
+    # dtype of the gradient D2H transfer feeding the host optimizer walk:
+    # "bf16" halves the host-link traffic (the reference's host Adam takes
+    # bf16 grads, csrc/adam cpu_adam bf16 path); fp32 master math either way
+    offload_grad_dtype: str = "fp32"
     # legacy keys accepted & ignored for compat with reference configs
     allgather_partitions: bool = True
     overlap_comm: bool = True
@@ -121,6 +125,10 @@ class ZeroConfig:
             self.offload_optimizer = None
         if self.offload_param == "none":
             self.offload_param = None
+        if self.offload_grad_dtype not in ("fp32", "bf16"):
+            raise ConfigError(
+                f"offload_grad_dtype must be fp32|bf16, got {self.offload_grad_dtype!r}"
+            )
         if self.offload_pipeline and self.offload_optimizer != "nvme":
             raise ConfigError(
                 "offload_optimizer pipeline/pipeline_read/pipeline_write is "

@@ -25,6 +25,7 @@ from ..models.transformer import (
     rope,
 )
 from ..ops.pallas.flash_attention import flash_attention
+from ..ops.quantizer import serving_mm
 from .paged import paged_attention_decode, write_decode_kv, write_prefill_kv
 
 Params = Any
@@ -33,9 +34,10 @@ Params = Any
 def _qkv(lw, x, cfg: TransformerConfig):
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
-    q = x @ lw["wq"]
-    k = x @ lw["wk"]
-    v = x @ lw["wv"]
+    # serving_mm: transparent over quantized-weight serving (ServingQuant)
+    q = serving_mm(x, lw["wq"])
+    k = serving_mm(x, lw["wk"])
+    v = serving_mm(x, lw["wv"])
     if cfg.qkv_bias:
         q, k, v = q + lw["bq"], k + lw["bk"], v + lw["bv"]
     return (
@@ -51,7 +53,13 @@ def _ffn(lw, x, cfg):
 
         out, _ = moe_block(lw["moe"], x, cfg)
         return out
-    return mlp_block(lw["mlp"], x, cfg)
+    mlp = lw["mlp"]
+    act = _activation(cfg.activation)
+    if cfg.gated_mlp:
+        h = act(serving_mm(x, mlp["w_gate"])) * serving_mm(x, mlp["w_up"])
+    else:
+        h = act(serving_mm(x, mlp["w_up"]))
+    return serving_mm(h, mlp["w_down"])
 
 
 def prefill(
@@ -95,14 +103,14 @@ def prefill(
         attn = flash_attention(
             q, k, v, causal=True, logits_soft_cap=cfg.logits_soft_cap
         )
-        attn = attn.reshape(1, s, -1) @ lw["attn"]["wo"]
+        attn = serving_mm(attn.reshape(1, s, -1), lw["attn"]["wo"])
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(length - 1, 0, s - 1)]  # [d]
-    logits = last @ head_kernel(params, cfg)  # [v]
+    logits = serving_mm(last, head_kernel(params, cfg))  # [v]
     return logits.astype(jnp.float32), (new_ck, new_cv)
 
 
@@ -159,14 +167,14 @@ def prefill_packed(
             q, k, v, causal=True, segment_ids=seg,
             logits_soft_cap=cfg.logits_soft_cap,
         )
-        attn = attn.reshape(1, t, -1) @ lw["attn"]["wo"]
+        attn = serving_mm(attn.reshape(1, t, -1), lw["attn"]["wo"])
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
-    logits = last @ head_kernel(params, cfg)  # [N, v]
+    logits = serving_mm(last, head_kernel(params, cfg))  # [N, v]
     return logits.astype(jnp.float32), (new_ck, new_cv)
 
 
@@ -208,10 +216,10 @@ def decode_step(
             q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
             logits_soft_cap=cfg.logits_soft_cap, mesh=mesh,
         )
-        attn = attn.reshape(b, 1, -1) @ lw["attn"]["wo"]
+        attn = serving_mm(attn.reshape(b, 1, -1), lw["attn"]["wo"])
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    logits = x[:, 0] @ head_kernel(params, cfg)
+    logits = serving_mm(x[:, 0], head_kernel(params, cfg))
     return logits.astype(jnp.float32), (new_ck, new_cv)

@@ -74,6 +74,54 @@ register("qwen2_7b", TransformerConfig(
     num_heads=28, num_kv_heads=4, max_seq_len=8192, rope_theta=1_000_000.0,
     qkv_bias=True, remat="dots", attn_impl="auto"))
 
+# --- Falcon (parallel attn+MLP, MQA, no biases) -----------------------------
+register("falcon_7b", TransformerConfig(
+    vocab_size=65024, hidden_size=4544, intermediate_size=18176, num_layers=32,
+    num_heads=71, num_kv_heads=1, head_dim=64, max_seq_len=2048,
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    parallel_block=True, rope_theta=10_000.0,
+    remat="dots", attn_impl="auto"))
+
+# --- GPT-J (parallel block, partial rotary, mlp biases) ---------------------
+register("gptj_6b", TransformerConfig(
+    vocab_size=50400, hidden_size=4096, intermediate_size=16384, num_layers=28,
+    num_heads=16, num_kv_heads=16, max_seq_len=2048, rotary_dim=64,
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    parallel_block=True, mlp_bias=True, rope_theta=10_000.0,
+    remat="dots", attn_impl="auto"))
+
+# --- Phi-2 (parallel block, partial rotary, biases everywhere) --------------
+register("phi_2", TransformerConfig(
+    vocab_size=51200, hidden_size=2560, intermediate_size=10240, num_layers=32,
+    num_heads=32, num_kv_heads=32, max_seq_len=2048, rotary_dim=32,
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    parallel_block=True, qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+    rope_theta=10_000.0, remat="dots", attn_impl="auto"))
+
+# --- GPT-NeoX-20B (parallel residual, rotary_pct=0.25, biases) --------------
+register("gpt_neox_20b", TransformerConfig(
+    vocab_size=50432, hidden_size=6144, intermediate_size=24576, num_layers=44,
+    num_heads=64, num_kv_heads=64, max_seq_len=2048, rotary_dim=24,
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    parallel_block=True, qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+    rope_theta=10_000.0, remat="full", attn_impl="auto"))
+
+# --- Bloom (ALiBi, embedding LN, all biases, tied) --------------------------
+register("bloom_7b1", TransformerConfig(
+    vocab_size=250880, hidden_size=4096, intermediate_size=16384, num_layers=30,
+    num_heads=32, num_kv_heads=32, max_seq_len=2048, position="alibi",
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    qkv_bias=True, attn_out_bias=True, mlp_bias=True, embedding_norm=True,
+    tie_embeddings=True, remat="dots", attn_impl="reference"))
+
+# --- OPT (learned positions, ReLU, all biases, tied) ------------------------
+register("opt_6_7b", TransformerConfig(
+    vocab_size=50272, hidden_size=4096, intermediate_size=16384, num_layers=32,
+    num_heads=32, num_kv_heads=32, max_seq_len=2048, position="learned",
+    norm="layernorm", activation="relu", gated_mlp=False,
+    qkv_bias=True, attn_out_bias=True, mlp_bias=True, tie_embeddings=True,
+    remat="dots", attn_impl="auto"))
+
 # --- tiny configs for tests -------------------------------------------------
 register("tiny", TransformerConfig(
     vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
@@ -86,3 +134,16 @@ register("tiny_gpt2", TransformerConfig(
     vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
     num_heads=4, num_kv_heads=4, max_seq_len=128, norm="layernorm",
     activation="gelu", gated_mlp=False, position="learned", tie_embeddings=True))
+register("tiny_parallel", TransformerConfig(
+    # falcon/phi-shaped: parallel block, partial rotary, biases
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=4, max_seq_len=128, rotary_dim=8,
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    parallel_block=True, qkv_bias=True, attn_out_bias=True, mlp_bias=True))
+register("tiny_alibi", TransformerConfig(
+    # bloom-shaped: alibi + embedding LN + biases, tied
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=4, max_seq_len=128, position="alibi",
+    norm="layernorm", activation="gelu", gated_mlp=False,
+    qkv_bias=True, attn_out_bias=True, mlp_bias=True, embedding_norm=True,
+    tie_embeddings=True, attn_impl="reference"))

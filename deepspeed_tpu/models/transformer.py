@@ -57,12 +57,23 @@ class TransformerConfig:
     norm: str = "rmsnorm"  # 'rmsnorm' (llama) | 'layernorm' (gpt2/bert)
     activation: str = "silu"  # 'silu' (swiglu) | 'gelu' (gpt2: plain mlp)
     gated_mlp: bool = True
-    position: str = "rope"  # 'rope' | 'learned' | 'none'
+    position: str = "rope"  # 'rope' | 'learned' | 'alibi' (bloom) | 'none'
     rope_theta: float = 500_000.0  # llama-3 default; llama-2 used 1e4
+    # partial rotary (gptj rotary_dim=64, phi-2=32, neox rotary_pct):
+    # rope applies to the FIRST rotary_dim of each head; None = full head
+    rotary_dim: Optional[int] = None
     qkv_bias: bool = False  # qwen-style
     tie_embeddings: bool = False
     norm_eps: float = 1e-5
     logits_soft_cap: Optional[float] = None  # gemma-2 style
+    # family switches (reference module_inject/containers: falcon/gptj/phi
+    # parallel attn+MLP, bloom alibi + embedding LN, gpt2/opt biases)
+    parallel_block: bool = False  # x + attn(ln(x)) + mlp(ln(x)), one shared LN
+    attn_out_bias: bool = False  # bias on the o-projection
+    mlp_bias: bool = False  # biases on the MLP projections
+    embedding_norm: bool = False  # bloom word_embeddings_layernorm
+    head_bias: bool = False  # lm_head bias (gptj/phi)
+    # (LayerNorm beta comes automatically with norm='layernorm')
     # MoE (Mixtral): >0 turns the MLP into a top-k routed expert layer
     moe_num_experts: int = 0
     moe_top_k: int = 2
@@ -148,12 +159,16 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, dtype=jnp.float32) -> Pa
             "wo": dinit(ks[3], (L, hq * hd, d)),
         },
         "attn_norm": {"scale": jnp.ones((L, d), dtype)},
-        "mlp_norm": {"scale": jnp.ones((L, d), dtype)},
     }
+    if not cfg.parallel_block:
+        # parallel blocks (falcon/gptj/phi) share attn_norm for both branches
+        layers["mlp_norm"] = {"scale": jnp.ones((L, d), dtype)}
     if cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, hq * hd), dtype)
         layers["attn"]["bk"] = jnp.zeros((L, hkv * hd), dtype)
         layers["attn"]["bv"] = jnp.zeros((L, hkv * hd), dtype)
+    if cfg.attn_out_bias:
+        layers["attn"]["bo"] = jnp.zeros((L, d), dtype)
     if cfg.moe_num_experts > 0:
         E = cfg.moe_num_experts
         layers["moe"] = {
@@ -169,6 +184,11 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, dtype=jnp.float32) -> Pa
         }
         if cfg.gated_mlp:
             mlp["w_gate"] = dinit(ks[4], (L, d, f))
+        if cfg.mlp_bias:
+            mlp["b_up"] = jnp.zeros((L, f), dtype)
+            mlp["b_down"] = jnp.zeros((L, d), dtype)
+            if cfg.gated_mlp:
+                mlp["b_gate"] = jnp.zeros((L, f), dtype)
         layers["mlp"] = mlp
 
     params: Params = {
@@ -178,12 +198,19 @@ def init_params(rng: jax.Array, cfg: TransformerConfig, dtype=jnp.float32) -> Pa
     }
     if cfg.position == "learned":
         params["pos_embed"] = {"embedding": _dense_init(ks[9], (cfg.max_seq_len, d), 1, dtype)}
+    if cfg.embedding_norm:
+        params["embed_norm"] = {"scale": jnp.ones((d,), dtype)}
     if cfg.norm == "layernorm":
         layers["attn_norm"]["bias"] = jnp.zeros((L, d), dtype)
-        layers["mlp_norm"]["bias"] = jnp.zeros((L, d), dtype)
+        if "mlp_norm" in layers:
+            layers["mlp_norm"]["bias"] = jnp.zeros((L, d), dtype)
         params["final_norm"]["bias"] = jnp.zeros((d,), dtype)
+        if cfg.embedding_norm:
+            params["embed_norm"]["bias"] = jnp.zeros((d,), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": _dense_init(ks[10], (d, v), 0, dtype)}
+        if cfg.head_bias:
+            params["lm_head"]["bias"] = jnp.zeros((v,), dtype)
     return params
 
 
@@ -202,6 +229,32 @@ def norm(x: jnp.ndarray, w: Params, kind: str, eps: float) -> jnp.ndarray:
     if "bias" in w:
         out = out + w["bias"]
     return out
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (bloom; 'Train Short, Test Long').  Geometric
+    sequence 2^(-8/n), with the standard non-power-of-2 extension."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    n = 2 ** int(math.floor(math.log2(num_heads)))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)[0::2][: num_heads - n]
+        slopes += extra
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def alibi_bias(
+    num_heads: int, q_positions: jnp.ndarray, kv_positions: jnp.ndarray
+) -> jnp.ndarray:
+    """[h, sq, skv] additive attention bias: -slope_h * (q_pos - k_pos)
+    for keys at or before the query (the causal mask handles the rest)."""
+    dist = q_positions[:, None].astype(jnp.float32) - kv_positions[None, :]
+    return -alibi_slopes(num_heads)[:, None, None] * jnp.maximum(dist, 0.0)
 
 
 def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
@@ -262,8 +315,19 @@ def attention_block(
     k = k.reshape(b, s, hkv, hd)
     v = v.reshape(b, s, hkv, hd)
     if cfg.position == "rope":
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        rot = cfg.rotary_dim or hd
+        if rot < hd:
+            # partial rotary (gptj/phi/neox): first `rot` dims rotate, the
+            # rest pass through
+            q = jnp.concatenate(
+                [rope(q[..., :rot], positions, cfg.rope_theta), q[..., rot:]], -1
+            )
+            k = jnp.concatenate(
+                [rope(k[..., :rot], positions, cfg.rope_theta), k[..., rot:]], -1
+            )
+        else:
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
     # named save points for remat='selective' (no-ops otherwise)
     q = _ckpt_name(q, "save_q")
     k = _ckpt_name(k, "save_k")
@@ -277,23 +341,42 @@ def attention_block(
         k, v = ck, cv
         new_cache = (ck, cv)
         q_offset = cache_index
+    kw = {}
+    if cfg.position == "alibi":
+        # [h, sq, skv] additive bias from absolute positions (bloom); the
+        # reference attention impl is the alibi-capable body (_get_attn_fn
+        # enforces this)
+        qpos = positions[0] if positions.ndim == 2 else positions
+        kw["bias"] = alibi_bias(hq, qpos, jnp.arange(k.shape[1]))
     out = attn_fn(
         q, k, v, causal=True, q_offset=q_offset,
         segment_ids=segment_ids,
         logits_soft_cap=cfg.logits_soft_cap,
+        **kw,
     )
     out = _ckpt_name(out, "save_attn")
     out = out.reshape(b, s, hq * hd) @ lw["wo"]
+    if "bo" in lw:
+        out = out + lw["bo"]
     return out, new_cache
 
 
 def mlp_block(lw: Params, x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
     act = _activation(cfg.activation)
+    up = x @ lw["w_up"]
+    if "b_up" in lw:
+        up = up + lw["b_up"]
     if cfg.gated_mlp:
-        h = act(x @ lw["w_gate"]) * (x @ lw["w_up"])
+        gate = x @ lw["w_gate"]
+        if "b_gate" in lw:
+            gate = gate + lw["b_gate"]
+        h = act(gate) * up
     else:
-        h = act(x @ lw["w_up"])
-    return h @ lw["w_down"]
+        h = act(up)
+    out = h @ lw["w_down"]
+    if "b_down" in lw:
+        out = out + lw["b_down"]
+    return out
 
 
 @functools.lru_cache(maxsize=None)
@@ -366,8 +449,16 @@ def decoder_layer(
     )
     if tp_axis is not None:
         h = _tp_psum_fn(tp_axis)(h)  # row-parallel wo partial sums
-    x = shard_activation(x + h.astype(dtype), ACT_SPEC)
     aux = jnp.asarray(0.0, jnp.float32)
+    if cfg.parallel_block:
+        # falcon/gptj/phi: both branches read the SAME normed input; one
+        # residual add (reference containers' parallel attn+mlp layout)
+        m = mlp_block(lw["mlp"], tp_in(attn_in), cfg)
+        if tp_axis is not None:
+            m = _tp_psum_fn(tp_axis)(m)
+        x = shard_activation(x + h.astype(dtype) + m.astype(dtype), ACT_SPEC)
+        return x, new_cache, aux
+    x = shard_activation(x + h.astype(dtype), ACT_SPEC)
     y = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
     if cfg.act_quant_bits:
         from ..compression.compress import quantize_activation
@@ -391,6 +482,16 @@ def decoder_layer(
 def _get_attn_fn(cfg: TransformerConfig) -> Callable:
     from ..ops.attention import get_attention_impl
 
+    if cfg.position == "alibi":
+        # additive [h, sq, skv] bias exists only in the reference attention
+        # body; the flash/sparse/SP paths have no bias operand yet
+        if cfg.attn_impl not in ("reference", "math") or (
+            cfg.sparse_attention is not None or cfg.sequence_parallel != "none"
+        ):
+            raise NotImplementedError(
+                "position='alibi' requires attn_impl='reference' without "
+                "sparse attention or sequence parallelism"
+            )
     if cfg.sparse_attention is not None:
         import functools as _ft
 
@@ -439,6 +540,9 @@ def forward(
     x = params["embed"]["embedding"][tokens].astype(cfg.dtype)
     if cfg.position == "learned":
         x = x + params["pos_embed"]["embedding"][positions].astype(cfg.dtype)
+    if cfg.embedding_norm:
+        # bloom word_embeddings_layernorm (module_inject containers/bloom)
+        x = norm(x, params["embed_norm"], cfg.norm, cfg.norm_eps)
     x = shard_activation(x, ACT_SPEC)
 
     if stack_apply is not None:
@@ -560,6 +664,9 @@ def forward(
     if return_hidden:
         return x, new_caches, aux_loss
     logits = x @ head_kernel(params, cfg)
+    hb = head_bias_vec(params)
+    if hb is not None:
+        logits = logits + hb
     return logits, new_caches, aux_loss
 
 
@@ -568,6 +675,12 @@ def head_kernel(params: Params, cfg: TransformerConfig) -> jnp.ndarray:
     if cfg.tie_embeddings:
         return params["embed"]["embedding"].T.astype(cfg.dtype)
     return params["lm_head"]["kernel"]
+
+
+def head_bias_vec(params: Params):
+    """[v] lm_head bias (gptj/phi) or None."""
+    lm = params.get("lm_head") if isinstance(params, dict) else None
+    return lm.get("bias") if lm else None
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None) -> Tuple:
@@ -641,6 +754,7 @@ class CausalLM:
             loss = chunked_cross_entropy(
                 hidden, head_kernel(params, self.cfg), labels,
                 chunk_size=self.cfg.loss_chunk_size,
+                head_bias=head_bias_vec(params),
             )
         else:
             logits, _, aux = forward(
@@ -693,5 +807,8 @@ def tp_rules(cfg: TransformerConfig):
         rules += [
             (r"layers/mlp/w_(gate|up)$", P(None, None, MODEL_AXIS)),
             (r"layers/mlp/w_down$", P(None, MODEL_AXIS, None)),
+            # col-parallel biases shard with their output dim; bo/b_down
+            # (row-parallel outputs) stay replicated by the default rule
+            (r"layers/mlp/b_(gate|up)$", P(None, MODEL_AXIS)),
         ]
     return rules

@@ -58,6 +58,7 @@ def dot_product_attention(
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
     attn_mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Reference scaled-dot-product attention.
 
@@ -66,6 +67,7 @@ def dot_product_attention(
     inference softmax kernels do the same for stability).
     ``attn_mask`` [sq, skv] bool composes with causal/segment masking
     (block-sparse layouts route through here, ops/sparse_attention.py).
+    ``bias`` [hq, sq, skv] adds to the pre-softmax logits (ALiBi).
     """
     in_dtype = q.dtype
     hq, hkv = q.shape[2], k.shape[2]
@@ -75,6 +77,8 @@ def dot_product_attention(
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(jnp.float32)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
+    if bias is not None:
+        logits = logits + bias[None].astype(jnp.float32)
     if logits_soft_cap is not None:
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
     if causal:

@@ -93,3 +93,79 @@ def fake_quantize_int8(x: jnp.ndarray, group_size: Optional[int] = None) -> jnp.
     """quantize→dequantize in one call (the reference's fake_quantizer.cu,
     used by compression's QAT path)."""
     return dequantize(quantize_int8(x, group_size))
+
+
+# ---------------------------------------------------------------------------
+# quantized-weight serving (reference csrc/fp_quantizer + inference/v2
+# cuda_linear FP6/quantized GEMMs; blogs/deepspeed-fp6)
+# ---------------------------------------------------------------------------
+class ServingQuant(NamedTuple):
+    """A kernel ``[..., in, out]`` stored compressed for serving: ``q`` in
+    int8 / fp8 with ONE fp32 scale per output channel.  Per-output-channel
+    scaling makes the dequant exact as a POST-matmul multiply —
+    ``(x @ q) * s`` — so the matmul reads the compressed bytes (half the
+    HBM traffic of bf16, the resource decode is bound by) and the scale
+    rides the output, never a materialized bf16 weight copy."""
+
+    q: jnp.ndarray  # int8 or float8_e4m3fn, same shape as the original
+    s: jnp.ndarray  # fp32 [out]
+
+
+def quantize_serving_weight(w: jnp.ndarray, fmt: str = "int8") -> ServingQuant:
+    """Per-output-channel symmetric compression of a ``[..., in, out]``
+    kernel (``fmt``: 'int8' | 'fp8').  Only the contraction dim (``in``,
+    axis -2) folds into each scale: stacked-layer kernels ``[L, in, out]``
+    get independent ``[L, out]`` scales that slice with the layer."""
+    xf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=w.ndim - 2)  # [..., out]
+    if fmt == "int8":
+        s = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(xf / s[..., None, :]), -127, 127).astype(jnp.int8)
+    elif fmt == "fp8":
+        fmax = float(jnp.finfo(jnp.float8_e4m3fn).max)
+        s = jnp.maximum(amax, 1e-12) / fmax
+        q = (xf / s[..., None, :]).astype(jnp.float8_e4m3fn)
+    else:
+        raise ValueError(f"quantize_weights format {fmt!r} (int8|fp8)")
+    return ServingQuant(q=q, s=s.astype(jnp.float32))
+
+
+def serving_mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` where ``w`` may be a :class:`ServingQuant`: the compressed
+    operand feeds the dot directly (int8/fp8 -> compute-dtype convert fuses
+    into the operand load) and the per-channel scale applies to the
+    output."""
+    if isinstance(w, ServingQuant):
+        y = x @ w.q.astype(x.dtype)
+        return (y * w.s.astype(jnp.float32)).astype(x.dtype)
+    return x @ w
+
+
+_SERVING_QUANT_PATHS = (
+    "attn/wq", "attn/wk", "attn/wv", "attn/wo",
+    "mlp/w_up", "mlp/w_gate", "mlp/w_down",
+    "lm_head/kernel",
+)
+
+
+def quantize_serving_params(params, fmt: str = "int8"):
+    """Compress the big matmul kernels of a CausalLM tree for serving;
+    embeddings (gathers) and norms stay in the original dtype.  Returns the
+    mixed tree — ``serving_mm`` consumes it transparently."""
+    from ..runtime.zero import path_str
+
+    def leaf(kp, x):
+        p = path_str(kp)
+        if getattr(x, "ndim", 0) >= 2 and any(p.endswith(t) for t in _SERVING_QUANT_PATHS):
+            return quantize_serving_weight(x, fmt)
+        return x
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def tree_nbytes(tree) -> int:
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype")
+    )

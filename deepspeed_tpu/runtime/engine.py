@@ -754,6 +754,12 @@ class DeepSpeedTpuEngine:
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         clip = cfg.gradient_clipping
+        # bf16 D2H halves the host-link bytes per step; accumulation and the
+        # norm stay fp32, only the transfer narrows (host Adam re-widens)
+        wire_dtype = (
+            jnp.bfloat16 if cfg.zero_optimization.offload_grad_dtype == "bf16"
+            else jnp.float32
+        )
 
         def grad_step(params, batch_, rng, step):
             def one(p, micro, r):
@@ -785,7 +791,11 @@ class DeepSpeedTpuEngine:
                 )
                 loss = lsum / gas
                 grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
-            return loss, grads, precision.global_grad_norm(grads)
+            gnorm = precision.global_grad_norm(grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(wire_dtype), grads
+            )
+            return loss, grads, gnorm
 
         jit_grad = self._jit(
             grad_step,

@@ -56,6 +56,7 @@ def chunked_cross_entropy(
     labels: jnp.ndarray,
     chunk_size: int = 1024,
     ignore_index: int = -100,
+    head_bias=None,
 ) -> jnp.ndarray:
     """CE from final hidden states without materialising full logits.
 
@@ -80,6 +81,8 @@ def chunked_cross_entropy(
         nll_sum, count = carry
         h, lab = xs
         logits = (h @ head_kernel).astype(jnp.float32)
+        if head_bias is not None:
+            logits = logits + head_bias.astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         safe = jnp.where(lab == ignore_index, 0, lab)
         gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]

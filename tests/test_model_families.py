@@ -1,0 +1,122 @@
+"""Model-family breadth (r4 VERDICT missing #6): parallel-block
+(falcon/gptj/phi), learned-position (gpt2/opt), and ALiBi (bloom) families —
+HF import logits parity against transformers + training smoke.
+
+Reference: module_inject/containers/ (20 policy files) +
+inference/v2/model_implementations/ (10 families)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.hf_import import load_hf_checkpoint
+from deepspeed_tpu.models import CausalLM, get_preset
+from deepspeed_tpu.models.transformer import forward
+
+
+def _save(model, tmp_path):
+    model.eval()  # gpt2/opt/bloom carry active dropout modules
+    d = str(tmp_path / "hf_model")
+    model.save_pretrained(d, safe_serialization=True)
+    return d
+
+
+def _parity(d, hf_model, rtol=2e-4, atol=2e-4):
+    params, cfg = load_hf_checkpoint(d)
+    x = np.array([[1, 5, 9, 42, 99, 3, 17, 8]], dtype=np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(x, dtype=torch.long)).logits.numpy()
+    got, _, _ = forward(params, jnp.asarray(x), cfg.replace(dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(got, np.float32), ref, rtol=rtol, atol=atol)
+    return cfg
+
+
+def test_gpt2_parity(tmp_path):
+    torch.manual_seed(0)
+    m = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        torch_dtype="float32"))
+    cfg = _parity(_save(m, tmp_path), m)
+    assert cfg.position == "learned" and cfg.tie_embeddings
+
+
+def test_opt_parity(tmp_path):
+    torch.manual_seed(0)
+    m = transformers.OPTForCausalLM(transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        activation_function="relu", do_layer_norm_before=True,
+        torch_dtype="float32"))
+    cfg = _parity(_save(m, tmp_path), m)
+    assert cfg.activation == "relu" and cfg.position == "learned"
+
+
+def test_bloom_parity(tmp_path):
+    torch.manual_seed(0)
+    m = transformers.BloomForCausalLM(transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        torch_dtype="float32"))
+    cfg = _parity(_save(m, tmp_path), m)
+    assert cfg.position == "alibi" and cfg.embedding_norm
+
+
+def test_falcon_parity(tmp_path):
+    torch.manual_seed(0)
+    m = transformers.FalconForCausalLM(transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        bias=False, new_decoder_architecture=False, alibi=False,
+        torch_dtype="float32"))
+    cfg = _parity(_save(m, tmp_path), m)
+    assert cfg.parallel_block and cfg.num_kv_heads == 1  # MQA
+
+
+def test_gptj_parity(tmp_path):
+    torch.manual_seed(0)
+    m = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        rotary_dim=8, torch_dtype="float32"))
+    cfg = _parity(_save(m, tmp_path), m)
+    assert cfg.parallel_block and cfg.rotary_dim == 8 and cfg.head_bias
+
+
+def test_phi_parity(tmp_path):
+    torch.manual_seed(0)
+    m = transformers.PhiForCausalLM(transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        partial_rotary_factor=0.5, torch_dtype="float32"))
+    cfg = _parity(_save(m, tmp_path), m)
+    assert cfg.parallel_block and cfg.rotary_dim == 8
+
+
+@pytest.mark.parametrize("preset", ["tiny_parallel", "tiny_alibi"])
+def test_new_family_presets_train(preset):
+    cfg = get_preset(preset)
+    model = CausalLM(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+        },
+        mesh=deepspeed_tpu.initialize_mesh(fsdp=8),
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (16, 33)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_family_presets_registered():
+    for name in ("falcon_7b", "gptj_6b", "phi_2", "gpt_neox_20b",
+                 "bloom_7b1", "opt_6_7b"):
+        cfg = get_preset(name)
+        assert cfg.param_count > 1e9, name

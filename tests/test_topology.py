@@ -71,7 +71,7 @@ def test_multinode_runner_commands():
     assert pdsh[0] == "pdsh" and "worker-0,worker-1,worker-2" in pdsh
     assert "DSTPU_PROCESS_ID=$i" in pdsh[-1]
 
-    assert set(RUNNERS) == {"pdsh", "openmpi", "mpich", "slurm", "mvapich"}
+    assert set(RUNNERS) == {"pdsh", "openmpi", "mpich", "impi", "slurm", "mvapich"}
 
 
 def test_scheduler_rank_env_discovery(monkeypatch):
