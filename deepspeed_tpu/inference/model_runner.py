@@ -82,8 +82,10 @@ def prefill(
         x = x + params["pos_embed"]["embedding"][jnp.arange(s)][None].astype(cfg.dtype)
     ck, cv = kv_cache
     # python loop over layers: each layer writes its cache page slab.
-    # (L is static; unrolled trace is fine for inference graphs)
-    new_ck, new_cv = ck, cv
+    # (L is static; unrolled trace is fine for inference graphs).  The KV
+    # pools are per-layer tuples — updates replace one layer's buffer
+    # in-place under donation, never a stacked-pool slice copy.
+    new_ck, new_cv = list(ck), list(cv)
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
@@ -91,11 +93,11 @@ def prefill(
         if cfg.position == "rope":
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
-        new_ck = new_ck.at[l].set(
-            write_prefill_kv(new_ck[l], k[0].astype(new_ck.dtype), blocks, length)
+        new_ck[l] = write_prefill_kv(
+            new_ck[l], k[0].astype(new_ck[l].dtype), blocks, length
         )
-        new_cv = new_cv.at[l].set(
-            write_prefill_kv(new_cv[l], v[0].astype(new_cv.dtype), blocks, length)
+        new_cv[l] = write_prefill_kv(
+            new_cv[l], v[0].astype(new_cv[l].dtype), blocks, length
         )
         # dispatcher: Pallas flash kernel on TPU when the shape qualifies
         # (prompt >= 128, tile-divisible), else the fused XLA body — serving
@@ -111,7 +113,7 @@ def prefill(
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(length - 1, 0, s - 1)]  # [d]
     logits = serving_mm(last, head_kernel(params, cfg))  # [v]
-    return logits.astype(jnp.float32), (new_ck, new_cv)
+    return logits.astype(jnp.float32), (tuple(new_ck), tuple(new_cv))
 
 
 def prefill_packed(
@@ -140,12 +142,12 @@ def prefill_packed(
             jnp.clip(positions, 0, cfg.max_seq_len - 1)
         ][None].astype(cfg.dtype)
     ck, cv = kv_cache
-    nb = ck.shape[1]
+    nb = ck[0].shape[0]
     # padding tokens scatter out of bounds and are dropped
     safe_page = jnp.where(page_idx >= 0, page_idx, nb)
     seg = segment_ids[None]  # [1, T]
     pos2 = positions[None]
-    new_ck, new_cv = ck, cv
+    new_ck, new_cv = list(ck), list(cv)
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
@@ -153,11 +155,11 @@ def prefill_packed(
         if cfg.position == "rope":
             q = rope(q, pos2, cfg.rope_theta)
             k = rope(k, pos2, cfg.rope_theta)
-        new_ck = new_ck.at[l, safe_page, page_off].set(
-            k[0].astype(new_ck.dtype), mode="drop"
+        new_ck[l] = new_ck[l].at[safe_page, page_off].set(
+            k[0].astype(new_ck[l].dtype), mode="drop"
         )
-        new_cv = new_cv.at[l, safe_page, page_off].set(
-            v[0].astype(new_cv.dtype), mode="drop"
+        new_cv[l] = new_cv[l].at[safe_page, page_off].set(
+            v[0].astype(new_cv[l].dtype), mode="drop"
         )
         # packed order == position order within each segment, so causal
         # masking by buffer index + segment masking is exact.  The flash
@@ -175,7 +177,7 @@ def prefill_packed(
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
     logits = serving_mm(last, head_kernel(params, cfg))  # [N, v]
-    return logits.astype(jnp.float32), (new_ck, new_cv)
+    return logits.astype(jnp.float32), (tuple(new_ck), tuple(new_cv))
 
 
 def decode_step(
@@ -198,7 +200,7 @@ def decode_step(
         ]
         x = x + pe[:, None].astype(cfg.dtype)
     ck, cv = kv_cache
-    new_ck, new_cv = ck, cv
+    new_ck, new_cv = list(ck), list(cv)
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
@@ -206,11 +208,11 @@ def decode_step(
         if cfg.position == "rope":
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
-        new_ck = new_ck.at[l].set(
-            write_decode_kv(new_ck[l], k[:, 0], block_tables, seq_lens, active)
+        new_ck[l] = write_decode_kv(
+            new_ck[l], k[:, 0], block_tables, seq_lens, active
         )
-        new_cv = new_cv.at[l].set(
-            write_decode_kv(new_cv[l], v[:, 0], block_tables, seq_lens, active)
+        new_cv[l] = write_decode_kv(
+            new_cv[l], v[:, 0], block_tables, seq_lens, active
         )
         attn = paged_attention_decode(
             q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
@@ -222,4 +224,4 @@ def decode_step(
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     logits = serving_mm(x[:, 0], head_kernel(params, cfg))
-    return logits.astype(jnp.float32), (new_ck, new_cv)
+    return logits.astype(jnp.float32), (tuple(new_ck), tuple(new_cv))

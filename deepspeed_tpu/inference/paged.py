@@ -22,9 +22,18 @@ from ..ops.attention import repeat_kv
 def init_paged_cache(
     num_layers: int, num_blocks: int, block_size: int, num_kv_heads: int,
     head_dim: int, dtype=jnp.bfloat16,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...]]:
+    """Per-LAYER block pools (tuple of [num_blocks, bs, hkv, hd] arrays),
+    not one stacked [L, ...] array: a stacked pool forces XLA to
+    materialize each layer's slice as a pallas-operand copy and to stitch
+    updates back with full-slice dynamic-update-slices — measured 11.4 GB
+    of HBM traffic per decode tick at 410M/batch-64 vs ~1.9 GB with
+    per-layer buffers (the difference between 31 ms and single-digit-ms
+    ticks)."""
+    shape = (num_blocks, block_size, num_kv_heads, head_dim)
+    k = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+    v = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+    return k, v
 
 
 def write_prefill_kv(cache_layer, kv, blocks, length):
@@ -126,7 +135,8 @@ def kv_pool_pspec(num_kv_heads: int, tp: int):
     from ..parallel.topology import MODEL_AXIS
 
     head_axis = MODEL_AXIS if (tp > 1 and num_kv_heads % tp == 0) else None
-    return P(None, None, None, head_axis, None)
+    # per-LAYER pool arrays [nb, bs, hkv, hd] (init_paged_cache)
+    return P(None, None, head_axis, None)
 
 
 def _paged_attention_decode_tp(

@@ -8,6 +8,11 @@ from deepspeed_tpu.autotuning import Autotuner, autotune_model
 from deepspeed_tpu.models import CausalLM, get_preset
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def _factory(remat):
     return CausalLM(get_preset("tiny", remat=remat, max_seq_len=32))
 

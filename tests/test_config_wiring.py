@@ -11,6 +11,11 @@ from deepspeed_tpu.config.config import ConfigError, parse_config
 from deepspeed_tpu.models import CausalLM, get_preset
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def _base_config(**extra):
     cfg = {
         "train_micro_batch_size_per_gpu": 8,

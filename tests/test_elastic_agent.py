@@ -26,6 +26,11 @@ ELASTIC_CFG = {
 }
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def test_compute_world_scales_down():
     agent = ElasticAgent(ELASTIC_CFG, ["true"])
     w4 = agent.compute_world(4)

@@ -24,6 +24,11 @@ BASE = {
 }
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def test_v01_batch_and_valid_gpus_deterministic():
     """The reference's own doc example: this config resolves to 9792 with
     a fixed valid-gpu list (tests/unit/elasticity values)."""

@@ -24,6 +24,11 @@ from deepspeed_tpu.checkpoint.hf_import import (
 from deepspeed_tpu.models.transformer import CausalLM, forward
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def _tiny_llama_dir(tmp_path, tie=False):
     cfg = transformers.LlamaConfig(
         vocab_size=128,

@@ -11,6 +11,11 @@ from deepspeed_tpu.models import CausalLM, get_preset
 from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def _train_engine(model=None):
     cfg = get_preset("tiny", max_seq_len=64).replace(dtype=jnp.float32)
     model = model or CausalLM(cfg)

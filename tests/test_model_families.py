@@ -44,6 +44,7 @@ def test_gpt2_parity(tmp_path):
     assert cfg.position == "learned" and cfg.tie_embeddings
 
 
+@pytest.mark.nightly  # slow e2e
 def test_opt_parity(tmp_path):
     torch.manual_seed(0)
     m = transformers.OPTForCausalLM(transformers.OPTConfig(
@@ -75,6 +76,7 @@ def test_falcon_parity(tmp_path):
     assert cfg.parallel_block and cfg.num_kv_heads == 1  # MQA
 
 
+@pytest.mark.nightly  # slow e2e
 def test_gptj_parity(tmp_path):
     torch.manual_seed(0)
     m = transformers.GPTJForCausalLM(transformers.GPTJConfig(
@@ -84,6 +86,7 @@ def test_gptj_parity(tmp_path):
     assert cfg.parallel_block and cfg.rotary_dim == 8 and cfg.head_bias
 
 
+@pytest.mark.nightly  # slow e2e
 def test_phi_parity(tmp_path):
     torch.manual_seed(0)
     m = transformers.PhiForCausalLM(transformers.PhiConfig(
@@ -95,6 +98,7 @@ def test_phi_parity(tmp_path):
 
 
 @pytest.mark.parametrize("preset", ["tiny_parallel", "tiny_alibi"])
+@pytest.mark.nightly  # slow e2e
 def test_new_family_presets_train(preset):
     cfg = get_preset(preset)
     model = CausalLM(cfg)

@@ -102,6 +102,7 @@ def _loco_zero(reset_T=1024):
     }
 
 
+@pytest.mark.nightly  # slow e2e
 def test_loco_trains_and_tracks_dense():
     ref = [
         float(_engine(zero={"stage": 3, "param_persistence_threshold": 0}).train_batch(b))

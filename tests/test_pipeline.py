@@ -163,6 +163,7 @@ def test_pipeline_apply_grads_match(stage_mesh):
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gs), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipelined_causal_lm_matches_dense(stage_mesh):
     cfg = get_preset("tiny", num_layers=4)
     dense = CausalLM(cfg)
@@ -175,6 +176,7 @@ def test_pipelined_causal_lm_matches_dense(stage_mesh):
     assert abs(l_dense - l_piped) < 2e-3, (l_dense, l_piped)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipelined_trains_end_to_end(stage_mesh):
     import deepspeed_tpu as ds
 
@@ -302,6 +304,7 @@ def test_pipeline_no_emit_stream_memory(stage_mesh):
     assert temp <= budget, (temp, budget)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipeline_backward_memory_independent_of_num_micro(stage_mesh):
     """r3 VERDICT weak #2: backward residuals must be O(S), not O(M).
 
@@ -451,6 +454,7 @@ def test_interpreter_inference_schedule():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_interpreter_matches_fused_executor(stage_mesh):
     """Oracle check: the instruction interpreter and the fused XLA executor
     produce identical gradients for the same pipeline."""
@@ -482,6 +486,7 @@ def test_interpreter_matches_fused_executor(stage_mesh):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipeline_grads_correct_when_batch_replicated():
     """r4 review: when mb doesn't divide the DP axes, filter_spec replicates
     the batch — the hand-written backward must NOT psum weight grads over
@@ -516,6 +521,7 @@ def test_pipeline_grads_correct_when_batch_replicated():
         set_current_mesh(None)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipelined_packed_segments_match_dense(stage_mesh):
     """r4: packed-sequence segment_ids ride the pipeline (VERDICT r3 weak
     #4) — pipelined loss on packed data must match the dense path."""
@@ -569,6 +575,7 @@ def test_pipelined_tp_composition_matches_dense():
         set_current_mesh(None)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipelined_tp_trains_end_to_end():
     """PP x TP x fsdp through the full engine (dryrun_multichip case 6's
     shape, asserted here on the CPU mesh)."""

@@ -124,6 +124,7 @@ def _train(engine, steps=6):
 
 
 @pytest.mark.parametrize("qw,qg", [(True, False), (False, True), (True, True)])
+@pytest.mark.nightly  # slow e2e
 def test_zeropp_trains_and_tracks_dense(qw, qg):
     zero = {
         "stage": 3,

@@ -16,6 +16,11 @@ from deepspeed_tpu.models import CausalLM, get_preset
 from deepspeed_tpu.parallel.topology import FSDP_AXIS, SUB_AXIS
 
 
+
+# full-area e2e coverage: nightly lane (r4 VERDICT weak #5 — the
+# default lane must gate commits in <5 min)
+pytestmark = pytest.mark.nightly
+
 def _axes_in(spec):
     out = set()
     for e in tuple(spec):
