@@ -293,7 +293,9 @@ def longctx_main():
         seq = 131_072
         cfg = get_preset("tiny", max_seq_len=seq).replace(
             hidden_size=512, num_layers=4, num_heads=8, num_kv_heads=8,
+            head_dim=128,  # MXU-native lanes for the flash kernel
             vocab_size=8192, remat="selective", loss_chunk_size=8192,
+            attn_impl="flash",  # dense attention would materialize [s, s]
         )
         steps = 2
     else:

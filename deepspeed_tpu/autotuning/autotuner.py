@@ -207,6 +207,153 @@ class Autotuner:
         return cfg, self.experiments
 
 
+class LaunchedAutotuner:
+    """Launcher-driven experiment search (reference autotuner.py:663 +
+    scheduler.py): each candidate runs as a SEPARATE process —
+    ``python -m deepspeed_tpu.autotuning.exp_runner`` locally, or wrapped
+    by any ``launcher.multinode_runner`` backend (pdsh/mpi/slurm/...) for
+    real multi-host measurements — and reports metrics through a JSON
+    file.  Crashes and OOMs kill the experiment process, never the
+    search; that isolation (and cross-host truth) is what the in-process
+    :class:`Autotuner` cannot offer."""
+
+    def __init__(
+        self,
+        preset: str,
+        seq_len: int,
+        base_config: Dict[str, Any],
+        overrides: Optional[Dict[str, Any]] = None,
+        micro_batches: Sequence[int] = (1, 2, 4, 8),
+        remat_policies: Sequence[str] = ("none", "selective", "full"),
+        zero_stages: Sequence[int] = (1, 2, 3),
+        mesh_candidates: Optional[Sequence[Dict[str, int]]] = None,
+        steps: int = 3,
+        metric: str = "throughput",
+        max_trials: Optional[int] = None,
+        launcher: Optional[str] = None,
+        hosts: Optional[Dict[str, int]] = None,
+        timeout: float = 600.0,
+        workdir: Optional[str] = None,
+    ):
+        self.preset = preset
+        self.seq_len = seq_len
+        self.base_config = dict(base_config)
+        self.overrides = dict(overrides or {})
+        self.micro_batches = list(micro_batches)
+        self.remat_policies = list(remat_policies)
+        self.zero_stages = list(zero_stages)
+        self.mesh_candidates = list(mesh_candidates or [{}])
+        self.steps = steps
+        self.metric = metric
+        self.max_trials = max_trials
+        self.launcher = launcher
+        self.hosts = hosts
+        self.timeout = timeout
+        self.workdir = workdir
+        self.experiments: List[Experiment] = []
+
+    def _cmd(self, spec_path: str, out_path: str) -> List[str]:
+        import sys
+
+        cmd = [
+            sys.executable, "-m", "deepspeed_tpu.autotuning.exp_runner",
+            "--spec", spec_path, "--out", out_path,
+        ]
+        if self.launcher:
+            from ..launcher.multinode_runner import get_runner
+
+            if not self.hosts:
+                raise ValueError("launcher mode needs a hosts dict")
+            return get_runner(self.launcher, self.hosts).get_cmd(cmd)
+        return cmd
+
+    def _run_one(self, exp: Experiment, idx: int) -> None:
+        import json
+        import os
+        import subprocess
+        import tempfile
+
+        wd = self.workdir or tempfile.mkdtemp(prefix="dstpu_autotune_")
+        os.makedirs(wd, exist_ok=True)
+        config = dict(self.base_config)
+        config["train_micro_batch_size_per_gpu"] = exp.micro_batch
+        config.setdefault("steps_per_print", 1_000_000)
+        zo = dict(config.get("zero_optimization", {}))
+        zo["stage"] = exp.zero_stage
+        config["zero_optimization"] = zo
+        spec = {
+            "preset": self.preset,
+            "overrides": {**self.overrides, "remat": exp.remat,
+                          "max_seq_len": self.seq_len},
+            "config": config,
+            "seq_len": self.seq_len,
+            "steps": self.steps,
+            "mesh_axes": exp.mesh_axes,
+        }
+        spec_path = os.path.join(wd, f"exp{idx}_spec.json")
+        out_path = os.path.join(wd, f"exp{idx}_metrics.json")
+        with open(spec_path, "w") as fh:
+            json.dump(spec, fh)
+        try:
+            subprocess.run(
+                self._cmd(spec_path, out_path), timeout=self.timeout,
+                capture_output=True,
+            )
+            with open(out_path) as fh:
+                metrics = json.load(fh)
+        except subprocess.TimeoutExpired:
+            metrics = {"error": f"timeout after {self.timeout}s"}
+        except FileNotFoundError:
+            metrics = {"error": "experiment produced no metrics file"}
+        if "error" in metrics:
+            exp.error = metrics["error"]
+        else:
+            exp.step_time = float(metrics["step_time"])
+            exp.tokens_per_sec = float(metrics["tokens_per_sec"])
+
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], List[Experiment]]:
+        if self.metric not in TUNING_METRICS:
+            raise ValueError(f"metric must be one of {TUNING_METRICS}")
+        trials = 0
+        for mesh, stage, remat, micro in itertools.product(
+            self.mesh_candidates, self.zero_stages, self.remat_policies,
+            self.micro_batches,
+        ):
+            if self.max_trials is not None and trials >= self.max_trials:
+                break
+            exp = Experiment(
+                micro_batch=micro, remat=remat, zero_stage=stage,
+                mesh_axes=dict(mesh),
+            )
+            self._run_one(exp, trials)
+            self.experiments.append(exp)
+            trials += 1
+            status = (
+                f"{exp.tokens_per_sec:,.0f} tok/s"
+                if exp.feasible else f"FAILED ({exp.error})"
+            )
+            log_dist(f"autotune[launched]: {exp.describe()} -> {status}")
+        feasible = [e for e in self.experiments if e.feasible]
+        if not feasible:
+            return None, self.experiments
+        key = (
+            (lambda e: -e.tokens_per_sec) if self.metric == "throughput"
+            else (lambda e: e.step_time)
+        )
+        best = min(feasible, key=key)
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = best.micro_batch
+        zo = dict(cfg.get("zero_optimization", {}))
+        zo["stage"] = best.zero_stage
+        cfg["zero_optimization"] = zo
+        cfg["_autotune"] = {
+            "remat": best.remat, "mesh": best.mesh_axes,
+            "tokens_per_sec": best.tokens_per_sec,
+            "step_time": best.step_time,
+        }
+        return cfg, self.experiments
+
+
 def autotune_model(
     preset: str,
     seq_len: int,

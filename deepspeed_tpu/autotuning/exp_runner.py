@@ -1,0 +1,78 @@
+"""One autotuning experiment as a STANDALONE process.
+
+The launcher-driven half of the autotuner (reference
+``autotuning/autotuner.py:663`` + ``scheduler.py``): the search spawns this
+module per candidate — through the plain interpreter locally or through any
+``launcher.multinode_runner`` backend across hosts — and reads the metrics
+file back.  Process isolation is the point: an OOM or a compiler crash
+kills THIS process, not the search (the reference launches experiment runs
+for exactly that reason), and a multi-host candidate measures real
+cross-host collectives instead of the in-process single-host proxy.
+
+Protocol: ``python -m deepspeed_tpu.autotuning.exp_runner --spec spec.json
+--out metrics.json``; the spec carries {preset, overrides, config, seq_len,
+steps, mesh_axes}; the metrics file carries {step_time, tokens_per_sec} or
+{error}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Any, Dict
+
+
+def run_experiment_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from ..models import CausalLM, get_preset
+
+    cfg = get_preset(spec["preset"], **(spec.get("overrides") or {}))
+    model = CausalLM(cfg)
+    mesh_axes = spec.get("mesh_axes") or {}
+    mesh = ds.initialize_mesh(**mesh_axes) if mesh_axes else None
+    engine, _, _, _ = ds.initialize(
+        model=model, config=dict(spec["config"]), mesh=mesh
+    )
+    seq_len = int(spec["seq_len"])
+    steps = int(spec.get("steps", 3))
+    micro = engine.config.train_micro_batch_size_per_gpu
+    dp = engine.grid.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(
+            0, cfg.vocab_size, (1, micro * dp, seq_len + 1)
+        ).astype(np.int32)
+    }
+    loss = engine.train_batch(batch)  # compile + warmup
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    float(loss)
+    step_time = (time.perf_counter() - t0) / steps
+    return {
+        "step_time": step_time,
+        "tokens_per_sec": micro * dp * seq_len / step_time,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="autotuning experiment runner")
+    ap.add_argument("--spec", required=True, help="experiment spec JSON path")
+    ap.add_argument("--out", required=True, help="metrics output JSON path")
+    args = ap.parse_args(argv)
+    with open(args.spec) as fh:
+        spec = json.load(fh)
+    try:
+        metrics = run_experiment_spec(spec)
+    except Exception as e:  # noqa: BLE001 — the metrics file IS the report
+        metrics = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    with open(args.out, "w") as fh:
+        json.dump(metrics, fh)
+    return 0 if "error" not in metrics else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
