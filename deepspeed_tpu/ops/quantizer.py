@@ -131,14 +131,113 @@ def quantize_serving_weight(w: jnp.ndarray, fmt: str = "int8") -> ServingQuant:
 
 
 def serving_mm(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` where ``w`` may be a :class:`ServingQuant`: the compressed
-    operand feeds the dot directly (int8/fp8 -> compute-dtype convert fuses
-    into the operand load) and the per-channel scale applies to the
-    output."""
+    """``x @ w`` where ``w`` may be a :class:`ServingQuant` (int8/fp8) or
+    :class:`ServingQuantFP6`: the compressed operand feeds the dot (the
+    convert/unpack fuses into the operand load) and the per-channel scale
+    applies to the output."""
     if isinstance(w, ServingQuant):
         y = x @ w.q.astype(x.dtype)
         return (y * w.s.astype(jnp.float32)).astype(x.dtype)
+    if isinstance(w, ServingQuantFP6):
+        codes = _fp6_unpack(w.packed, w.in_dim)
+        y = x @ _fp6_decode(codes, x.dtype)
+        return (y * w.s.astype(jnp.float32)).astype(x.dtype)
     return x @ w
+
+
+class ServingQuantFP6:
+    """FP6 (e2m3) serving weight: four 6-bit codes bit-packed into three
+    bytes along the contraction dim + one fp32 scale per output channel —
+    0.75 bytes/weight, the reference's TC-FPx format class
+    (``csrc/fp_quantizer``, blogs/deepspeed-fp6).  Decode is pure vector
+    arithmetic (no codebook gather): sign/exp/mantissa fields reassemble in
+    the compute dtype inside the matmul's producer fusion."""
+
+    def __init__(self, packed, s, in_dim: int):
+        self.packed = packed  # [..., 3*in/4, out] uint8
+        self.s = s  # [..., out] fp32
+        self.in_dim = int(in_dim)
+
+    def tree_flatten(self):
+        return (self.packed, self.s), self.in_dim
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+jax.tree_util.register_pytree_node(
+    ServingQuantFP6,
+    lambda x: x.tree_flatten(),
+    ServingQuantFP6.tree_unflatten,
+)
+
+_FP6_MAX = 7.5  # e2m3: (1 + 7/8) * 2^2
+
+
+def _fp6_encode(x: jnp.ndarray) -> jnp.ndarray:
+    """|x| <= 7.5 (pre-scaled) -> 6-bit e2m3 codes (uint8, low 6 bits)."""
+    sign = (x < 0).astype(jnp.uint8)
+    a = jnp.clip(jnp.abs(x), 0.0, _FP6_MAX)
+    # normal range needs e_real in [0, 2]; below 1.0 is subnormal (e=0)
+    e_real = jnp.clip(jnp.floor(jnp.log2(jnp.maximum(a, 1e-12))), 0.0, 2.0)
+    sub = a < 1.0
+    m = jnp.where(sub, jnp.round(a * 8.0), jnp.round((a / 2.0**e_real - 1.0) * 8.0))
+    e = jnp.where(sub, 0.0, e_real + 1.0)
+    # mantissa carry: m == 8 rolls into the next exponent
+    carry = m >= 8.0
+    m = jnp.where(carry, 0.0, m)
+    e = jnp.where(carry, e + 1.0, e)
+    over = e > 3.0
+    e = jnp.where(over, 3.0, e)
+    m = jnp.where(over, 7.0, m)
+    return (
+        (sign << 5)
+        | (e.astype(jnp.uint8) << 3)
+        | m.astype(jnp.uint8)
+    )
+
+
+def _fp6_decode(code: jnp.ndarray, dtype) -> jnp.ndarray:
+    s = (code >> 5) & 1
+    e = ((code >> 3) & 3).astype(jnp.float32)
+    m = (code & 7).astype(jnp.float32)
+    mag = jnp.where(e == 0, m / 8.0, (1.0 + m / 8.0) * (2.0 ** (e - 1.0)))
+    return (jnp.where(s == 1, -mag, mag)).astype(dtype)
+
+
+def _fp6_pack(codes: jnp.ndarray) -> jnp.ndarray:
+    """[..., in, out] 6-bit codes -> [..., 3*in/4, out] bytes (in % 4 == 0)."""
+    *lead, n, out = codes.shape
+    c = codes.reshape(*lead, n // 4, 4, out)
+    c0, c1, c2, c3 = c[..., 0, :], c[..., 1, :], c[..., 2, :], c[..., 3, :]
+    b0 = (c0 << 2) | (c1 >> 4)
+    b1 = ((c1 & 0xF) << 4) | (c2 >> 2)
+    b2 = ((c2 & 0x3) << 6) | c3
+    return jnp.stack([b0, b1, b2], axis=-2).reshape(*lead, 3 * n // 4, out)
+
+
+def _fp6_unpack(packed: jnp.ndarray, in_dim: int) -> jnp.ndarray:
+    *lead, _, out = packed.shape
+    b = packed.reshape(*lead, in_dim // 4, 3, out)
+    b0, b1, b2 = b[..., 0, :], b[..., 1, :], b[..., 2, :]
+    c0 = b0 >> 2
+    c1 = ((b0 & 0x3) << 4) | (b1 >> 4)
+    c2 = ((b1 & 0xF) << 2) | (b2 >> 6)
+    c3 = b2 & 0x3F
+    return jnp.stack([c0, c1, c2, c3], axis=-2).reshape(*lead, in_dim, out)
+
+
+def quantize_serving_weight_fp6(w: jnp.ndarray) -> ServingQuantFP6:
+    """Per-output-channel FP6 compression of a ``[..., in, out]`` kernel
+    (in % 4 == 0)."""
+    if w.shape[-2] % 4:
+        raise ValueError(f"fp6 packing needs in-dim % 4 == 0, got {w.shape}")
+    xf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=w.ndim - 2)  # [..., out]
+    s = jnp.maximum(amax, 1e-12) / _FP6_MAX
+    codes = _fp6_encode(xf / s[..., None, :])
+    return ServingQuantFP6(_fp6_pack(codes), s.astype(jnp.float32), w.shape[-2])
 
 
 _SERVING_QUANT_PATHS = (
@@ -149,14 +248,17 @@ _SERVING_QUANT_PATHS = (
 
 
 def quantize_serving_params(params, fmt: str = "int8"):
-    """Compress the big matmul kernels of a CausalLM tree for serving;
-    embeddings (gathers) and norms stay in the original dtype.  Returns the
-    mixed tree — ``serving_mm`` consumes it transparently."""
+    """Compress the big matmul kernels of a CausalLM tree for serving
+    (``fmt``: 'int8' | 'fp8' | 'fp6'); embeddings (gathers) and norms stay
+    in the original dtype.  Returns the mixed tree — ``serving_mm``
+    consumes it transparently."""
     from ..runtime.zero import path_str
 
     def leaf(kp, x):
         p = path_str(kp)
         if getattr(x, "ndim", 0) >= 2 and any(p.endswith(t) for t in _SERVING_QUANT_PATHS):
+            if fmt == "fp6":
+                return quantize_serving_weight_fp6(x)
             return quantize_serving_weight(x, fmt)
         return x
 

@@ -134,3 +134,66 @@ def test_quantize_rejects_tp(tiny_model):
     grid = deepspeed_tpu.initialize_mesh(model=2)
     with pytest.raises(ValueError, match="tensor-parallel"):
         InferenceEngineV2(params, model.cfg, grid=grid, quantize_weights="int8")
+
+
+# ---------------------------------------------------------------------------
+# FP6 (e2m3, bit-packed) — the reference TC-FPx format class
+# (csrc/fp_quantizer, blogs/deepspeed-fp6)
+# ---------------------------------------------------------------------------
+def test_fp6_roundtrip_and_pack():
+    from deepspeed_tpu.ops.quantizer import (
+        _fp6_decode,
+        _fp6_encode,
+        _fp6_pack,
+        _fp6_unpack,
+    )
+
+    # every representable magnitude round-trips exactly
+    vals = []
+    for s in (1, -1):
+        for e in range(4):
+            for m in range(8):
+                mag = m / 8.0 if e == 0 else (1 + m / 8.0) * 2.0 ** (e - 1)
+                vals.append(s * mag)
+    x = jnp.asarray(vals, jnp.float32)
+    codes = _fp6_encode(x)
+    np.testing.assert_allclose(np.asarray(_fp6_decode(codes, jnp.float32)),
+                               np.abs(np.asarray(x)) * np.sign(np.asarray(x)),
+                               rtol=0, atol=0)
+    # pack/unpack is the identity on codes
+    c2 = codes.reshape(16, 4).T.reshape(4, 16)  # any [in, out] view, in%4==0
+    np.testing.assert_array_equal(
+        np.asarray(_fp6_unpack(_fp6_pack(c2), 4)), np.asarray(c2)
+    )
+
+
+def test_fp6_serving_mm_accuracy_and_size():
+    from deepspeed_tpu.ops.quantizer import (
+        ServingQuantFP6,
+        quantize_serving_weight_fp6,
+        serving_mm,
+        tree_nbytes,
+    )
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    qw = quantize_serving_weight_fp6(w)
+    assert isinstance(qw, ServingQuantFP6)
+    # 0.75 bytes/weight + fp32 scales
+    assert qw.packed.shape == (48, 32) and qw.packed.dtype == jnp.uint8
+    ref = np.asarray(x @ w)
+    got = np.asarray(serving_mm(x, qw))
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    # e2m3: 3 mantissa bits -> coarser than int8, finer than nothing
+    assert rel < 0.06, rel
+
+
+def test_fp6_generation_runs(tiny_model):
+    model, params = tiny_model
+    eng = InferenceEngineV2(
+        params, model.cfg, max_seqs=2, num_blocks=64, block_size=8,
+        prefill_buckets=(16,), quantize_weights="fp6",
+    )
+    out = eng.generate([3, 1, 4, 1, 5, 9, 2, 6], SamplingParams(max_new_tokens=4))
+    assert len(out) == 4 and all(0 <= int(t) < model.cfg.vocab_size for t in out)
