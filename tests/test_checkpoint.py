@@ -92,6 +92,7 @@ def test_missing_checkpoint(tmp_path):
     assert path is None
 
 
+@pytest.mark.nightly  # slow e2e
 def test_async_checkpoint_save_and_resume(tmp_path):
     """checkpoint.async_save: save returns immediately, 'latest' appears only
     after commit, and the checkpoint restores exactly (reference

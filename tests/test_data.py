@@ -147,6 +147,7 @@ def test_truncate_to_seqlen():
 # ---------------------------------------------------------------------------
 # engine integration: seqlen curriculum ramps, loss still trains
 # ---------------------------------------------------------------------------
+@pytest.mark.nightly  # slow e2e
 def test_engine_curriculum_seqlen_ramp():
     from deepspeed_tpu.models import CausalLM, get_preset
 
@@ -207,6 +208,7 @@ def _make(tmpdir, ds):
     )
 
 
+@pytest.mark.nightly  # slow e2e
 def test_dataloader_position_rides_checkpoint(tmp_path):
     ds = _TokDataset()
     engine, _, loader, _ = _make(tmp_path, ds)

@@ -236,6 +236,7 @@ def test_pipeline_apply_with_aux_matches_sequential(stage_mesh):
     np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipelined_moe_composes_and_trains(stage_mesh):
     """PP + MoE: the r2 restriction is lifted — a Mixtral-style block stack
     trains under the pipelined executor with a live aux loss."""
@@ -369,6 +370,7 @@ def test_pipeline_backward_memory_independent_of_num_micro(stage_mesh):
 # ---------------------------------------------------------------------------
 # r4: instruction-interpreting executor (schedule objects are EXECUTED)
 # ---------------------------------------------------------------------------
+@pytest.mark.nightly  # slow e2e
 def test_interpreter_executes_train_schedule_with_parity():
     """The eager executor runs TrainSchedule instruction-for-instruction and
     reproduces dense autodiff exactly (out, weight grads, input cotangent)."""
@@ -408,6 +410,7 @@ def test_interpreter_executes_train_schedule_with_parity():
         assert stats.reduce_grads == S
 
 
+@pytest.mark.nightly  # slow e2e
 def test_interpreter_1f1b_live_buffers_are_O_stages():
     """1F1B's memory claim, measured on the executed schedule: each stage's
     peak count of live saved activations is min(S - sid, M) — independent of
@@ -544,6 +547,7 @@ def test_pipelined_packed_segments_match_dense(stage_mesh):
                for x in jax.tree_util.tree_leaves(g))
 
 
+@pytest.mark.nightly  # slow e2e
 def test_pipelined_tp_composition_matches_dense():
     """PP x TP (r4 VERDICT next #5): the pipelined stack with a >1 model
     axis runs MANUAL Megatron TP inside the fully-manual region (local
