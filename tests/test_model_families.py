@@ -124,3 +124,26 @@ def test_family_presets_registered():
                  "bloom_7b1", "opt_6_7b"):
         cfg = get_preset(name)
         assert cfg.param_count > 1e9, name
+
+
+@pytest.mark.parametrize("preset", ["tiny_parallel", "tiny_alibi"])
+def test_new_families_generate_v1(preset):
+    """v1 inference (dense KV cache) drives the new architectures: cached
+    decode must match the no-cache forward argmax path."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import SamplingParams, init_inference
+
+    cfg = get_preset(preset, dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = init_inference(model, params)
+    prompt = np.array([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+    out = eng.generate(prompt, SamplingParams(max_new_tokens=4))
+    assert out.shape == (1, 4)
+    # teacher-forced check: feeding prompt+generated through the plain
+    # forward must reproduce the same greedy choices
+    full = np.concatenate([prompt, out], axis=1)
+    logits, _, _ = forward(params, jnp.asarray(full), cfg)
+    greedy = np.asarray(jnp.argmax(logits[:, prompt.shape[1] - 1 : -1], -1))
+    np.testing.assert_array_equal(out, greedy)
