@@ -73,10 +73,11 @@ def test_tp_kv_pool_actually_sharded(gqa_model):
     eng = InferenceEngineV2(params, model.cfg, max_seqs=2, num_blocks=32,
                             block_size=8, prefill_buckets=(16,), grid=grid)
     ck, _ = eng.kv
-    spec = ck.sharding.spec
-    assert spec[3] == MODEL_AXIS
-    shard = ck.addressable_shards[0].data
-    assert shard.shape[3] == model.cfg.num_kv_heads // 2
+    # per-LAYER pool buffers: [num_blocks, bs, hkv, hd] each
+    spec = ck[0].sharding.spec
+    assert spec[2] == MODEL_AXIS
+    shard = ck[0].addressable_shards[0].data
+    assert shard.shape[2] == model.cfg.num_kv_heads // 2
     # param shardings: at least one leaf is actually split on 'model'
     shardings = jax.tree_util.tree_leaves(eng._param_shardings)
     assert any(MODEL_AXIS in tuple(s.spec) for s in shardings)
@@ -84,7 +85,7 @@ def test_tp_kv_pool_actually_sharded(gqa_model):
     eng.put([1], [[3, 1, 4, 1, 5]])
     eng.step()
     ck2, _ = eng.kv
-    assert ck2.sharding.spec[3] == MODEL_AXIS
+    assert ck2[0].sharding.spec[2] == MODEL_AXIS
 
 
 def test_tp_serving_rejects_bad_combos(gqa_model):
