@@ -38,11 +38,12 @@ def run_experiment_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
     seq_len = int(spec["seq_len"])
     steps = int(spec.get("steps", 3))
     micro = engine.config.train_micro_batch_size_per_gpu
+    gas = engine.config.gradient_accumulation_steps
     dp = engine.grid.dp_world_size
     rng = np.random.default_rng(0)
     batch = {
         "input_ids": rng.integers(
-            0, cfg.vocab_size, (1, micro * dp, seq_len + 1)
+            0, cfg.vocab_size, (gas, micro * dp, seq_len + 1)
         ).astype(np.int32)
     }
     loss = engine.train_batch(batch)  # compile + warmup

@@ -134,7 +134,9 @@ def test_packed_prefill_one_dispatch_for_many_prompts():
 
 
 def test_packed_prefill_splits_at_budget():
-    engine, _ = _engine(prefill_budget=24)
+    # budget accounting is PAGE-ALIGNED (block_size 8): 10-token prompts
+    # cost 16 padded slots each, so budget 32 holds two prompts per pack
+    engine, _ = _engine(prefill_budget=32)
     calls = []
     orig = engine._run_packed_prefill
 
@@ -146,8 +148,8 @@ def test_packed_prefill_splits_at_budget():
     rng = np.random.default_rng(3)
     prompts = [list(map(int, rng.integers(1, 250, n))) for n in (10, 10, 10)]
     engine.put([1, 2, 3], prompts)
-    assert len(calls) == 2  # 20 + 10: budget 24 splits after two prompts
-    assert all(c <= 24 for c in calls)
+    assert len(calls) == 2  # 16+16 padded, then 16: splits after two prompts
+    assert all(c <= 32 for c in calls)
 
 
 def test_packed_kernel_matches_dense_reference():
