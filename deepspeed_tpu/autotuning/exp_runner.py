@@ -55,7 +55,7 @@ def run_experiment_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
     step_time = (time.perf_counter() - t0) / steps
     return {
         "step_time": step_time,
-        "tokens_per_sec": micro * dp * seq_len / step_time,
+        "tokens_per_sec": gas * micro * dp * seq_len / step_time,
     }
 
 
