@@ -1,0 +1,94 @@
+"""Evoformer attention (DeepSpeed4Science): biased MSA attention.
+
+Reference: ``deepspeed/ops/deepspeed4science/evoformer_attn.py``
+``DS4Sci_EvoformerAttention(Q, K, V, [bias1, bias2])`` over the CUTLASS
+kernels in ``csrc/deepspeed4science/evoformer_attn/`` (~15k LoC of CUDA).
+Shapes follow AlphaFold2's Evoformer:
+
+- Q/K/V: ``[b, n, s, h, d]``  (batch, MSA rows, sequence, heads, head dim)
+- bias1: ``[b, n, 1, 1, s]``  — per-row mask bias (broadcast over heads+query)
+- bias2: ``[b, 1, h, s, s]``  — pair-representation bias (broadcast over rows)
+
+TPU-native formulation: the whole thing is one einsum-softmax-einsum with
+two additive broadcasts — exactly what XLA fuses well — plus a
+``jax.checkpoint``-chunked variant over the MSA-row dim so AlphaFold-scale
+``n`` does not materialize ``[b, n, h, s, s]`` logits at once.  Gradients
+(incl. bias gradients, which the reference's bwd kernel computes) come from
+autodiff.  A Pallas kernel is unnecessary at current sizes — SURVEY marks
+the native kernel optional ("Pallas if hot").
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _attn_block(q, k, v, bias1, bias2, scale):
+    # q/k/v [b, nc, s, h, d]; bias1 [b, nc, 1, 1, s]; bias2 [b, 1, h, s, s]
+    logits = jnp.einsum(
+        "bnqhd,bnkhd->bnhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias1 is not None:
+        # [b, nc, 1, 1, s]: keys masked per MSA row
+        logits = logits + bias1.astype(jnp.float32).transpose(0, 1, 2, 3, 4)
+    if bias2 is not None:
+        # [b, 1, h, s, s]: pair bias shared across rows
+        logits = logits + bias2.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnhqk,bnkhd->bnqhd", probs.astype(v.dtype), v)
+
+
+def evoformer_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    biases: Optional[List[Optional[jnp.ndarray]]] = None,
+    chunk_rows: Optional[int] = None,
+) -> jnp.ndarray:
+    """``DS4Sci_EvoformerAttention`` semantics (evoformer_attn.py:87).
+
+    ``chunk_rows`` bounds live logits to ``[b, chunk, h, s, s]`` by scanning
+    the MSA-row dim in remat'd chunks (the memory role of the reference's
+    fused kernel).
+    """
+    biases = list(biases or [])
+    while len(biases) < 2:
+        biases.append(None)
+    bias1, bias2 = biases
+    b, n, s, h, d = q.shape
+    if bias1 is not None and bias1.shape != (b, n, 1, 1, s):
+        raise ValueError(f"bias1 shape {bias1.shape} != {(b, n, 1, 1, s)}")
+    if bias2 is not None and bias2.shape != (b, 1, h, s, s):
+        raise ValueError(f"bias2 shape {bias2.shape} != {(b, 1, h, s, s)}")
+    scale = 1.0 / float(d) ** 0.5
+    if not chunk_rows or chunk_rows >= n:
+        return _attn_block(q, k, v, bias1, bias2, scale)
+    if n % chunk_rows:
+        raise ValueError(f"chunk_rows {chunk_rows} must divide MSA rows {n}")
+    nc = n // chunk_rows
+
+    def body(carry, xs):
+        qc, kc, vc, b1c = xs
+        out = jax.checkpoint(
+            lambda *a: _attn_block(*a, bias2, scale), prevent_cse=False
+        )(qc, kc, vc, b1c)
+        return carry, out
+
+    split = lambda x: x.reshape(b, nc, chunk_rows, *x.shape[2:]).transpose(
+        1, 0, *range(2, x.ndim + 1)
+    )
+    xs = (
+        split(q), split(k), split(v),
+        split(bias1) if bias1 is not None
+        else jnp.zeros((nc, b, chunk_rows, 1, 1, s), q.dtype),
+    )
+    _, outs = jax.lax.scan(body, None, xs)
+    # [nc, b, chunk, s, h, d] -> [b, n, s, h, d]
+    return outs.transpose(1, 0, 2, 3, 4, 5).reshape(b, n, s, h, d)
+
+
+def DS4Sci_EvoformerAttention(Q, K, V, biases):  # noqa: N802 — reference name
+    """Drop-in-named alias of the reference entry point."""
+    return evoformer_attention(Q, K, V, biases)
