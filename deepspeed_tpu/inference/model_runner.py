@@ -49,9 +49,11 @@ def _qkv(lw, x, cfg: TransformerConfig):
 
 def _ffn(lw, x, cfg):
     if cfg.moe_num_experts > 0:
-        from ..moe.layer import moe_block
+        # dropless at inference: capacity competition would make routing
+        # depend on batch padding (moe/layer.py moe_block_dropless)
+        from ..moe.layer import moe_block_dropless
 
-        out, _ = moe_block(lw["moe"], x, cfg)
+        out, _ = moe_block_dropless(lw["moe"], x, cfg)
         return out
     mlp = lw["mlp"]
     act = _activation(cfg.activation)

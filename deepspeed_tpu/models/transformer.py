@@ -465,9 +465,15 @@ def decoder_layer(
 
         y = quantize_activation(y, cfg.act_quant_bits)
     if cfg.moe_num_experts > 0:
-        from ..moe.layer import moe_block
+        if cache is not None:
+            # inference (KV-cache) path: dropless routing — capacity
+            # dropping is a training regularizer and would couple routing
+            # to batch/padding shape (moe/layer.py moe_block_dropless)
+            from ..moe.layer import moe_block_dropless as _moe
+        else:
+            from ..moe.layer import moe_block as _moe
 
-        h, aux = moe_block(lw["moe"], y, cfg)
+        h, aux = _moe(lw["moe"], y, cfg)
     else:
         h = mlp_block(lw["mlp"], tp_in(y), cfg)
     if tp_axis is not None:

@@ -59,6 +59,37 @@ def routed_ffn(
     return out.reshape(b, s, d), gate.aux_loss
 
 
+def moe_block_dropless(lw: Any, x: jnp.ndarray, cfg) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """INFERENCE MoE: exact top-k routing with NO capacity dropping.
+
+    Token dropping is a training-time load-balancing regularizer; serving
+    must route every token (the reference's inference-v2 MoE kernels gather/
+    scatter without capacity, ragged_ops moe_*), and capacity competition
+    would otherwise make routing depend on batch padding — a packed/padded
+    prefill would route REAL tokens differently than the same prompt alone.
+    Dense-all-experts formulation (E× FFN flops, exact): fine at decode
+    shapes and tolerable at prefill; a grouped-matmul kernel is the
+    optimization path if MoE serving becomes hot.
+    """
+    from ..models.transformer import _activation
+
+    act = _activation(cfg.activation)
+    b, s, d = x.shape
+    k = cfg.moe_top_k
+    xf = x.reshape(b * s, d)
+    logits = xf.astype(jnp.float32) @ lw["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)  # [N, k]
+    weights = topv / jnp.maximum(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+    h = act(jnp.einsum("nd,edf->nef", xf, lw["w_gate"])) * jnp.einsum(
+        "nd,edf->nef", xf, lw["w_up"]
+    )
+    y = jnp.einsum("nef,efd->ned", h, lw["w_down"])  # [N, E, d]
+    picked = jnp.take_along_axis(y, topi[:, :, None], axis=1)  # [N, k, d]
+    out = jnp.sum(picked * weights[:, :, None].astype(y.dtype), axis=1)
+    return out.reshape(b, s, d).astype(x.dtype), jnp.asarray(0.0, jnp.float32)
+
+
 def moe_block(lw: Any, x: jnp.ndarray, cfg) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Routed gated-FFN used inside the transformer block.
 

@@ -289,3 +289,22 @@ def test_step_n_stop_token_truncates():
     assert s2.done
     assert s2.tokens[-1] == int(stop)
     assert len(s2.tokens) <= len(seq.tokens)
+
+
+def test_v2_moe_matches_v1_dense():
+    """MoE serving parity (found in r5): inference routes DROPLESS — with
+    capacity routing, the padded/packed prefill would route real tokens
+    differently than the same prompt alone (capacity competition against
+    pad tokens), so v1 and v2 disagreed."""
+    cfg = get_preset("tiny_moe", dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    v1 = init_inference(model, params)
+    v2 = InferenceEngineV2(params, cfg, max_seqs=2, num_blocks=64,
+                           block_size=8, prefill_buckets=(16,))
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    n = 5
+    dense = v1.generate(np.asarray([prompt], np.int32),
+                        SamplingParams(max_new_tokens=n))[0].tolist()
+    paged = v2.generate(prompt, SamplingParams(max_new_tokens=n))
+    assert dense == paged, (dense, paged)
