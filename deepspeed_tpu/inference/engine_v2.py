@@ -58,11 +58,12 @@ class InferenceEngineV2:
         # weight HBM traffic halves and no bf16 copy is ever materialized.
         self.quantize_weights = quantize_weights
         if quantize_weights is not None:
-            if grid is not None and grid.spec.model > 1:
-                raise ValueError(
-                    "quantize_weights + tensor-parallel serving is not "
-                    "supported yet (TP sharding rules address raw kernels)"
-                )
+            # Quantize BEFORE TP sharding: the AutoTP walk then shards the
+            # compressed payloads (q classifies like its kernel — same path
+            # and trailing dims; scales ride the bias heuristic or
+            # replicate, which under GSPMD only affects layout, never
+            # numerics).  int8 TP serving is the multi-chip 70B capacity
+            # combo (reference: FP6 + TP in inference v2).
             from ..ops.quantizer import quantize_serving_params, tree_nbytes
 
             before = tree_nbytes(params)

@@ -96,3 +96,37 @@ def test_tp_serving_rejects_bad_combos(gqa_model):
     grid3 = initialize_mesh(devices=jax.devices()[:3], model=3)
     with pytest.raises(ValueError, match="divisible"):
         InferenceEngineV2(params, model.cfg, grid=grid3)
+
+
+def test_tp_serving_with_quantized_weights(gqa_model):
+    """TP x int8 serving (the multi-chip capacity combo): sharded compressed
+    weights must generate exactly like single-device compressed weights."""
+    model, params = gqa_model
+    kw = dict(max_seqs=2, num_blocks=64, block_size=8, prefill_buckets=(16,))
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    samp = SamplingParams(max_new_tokens=5)
+    solo = InferenceEngineV2(
+        params, model.cfg, quantize_weights="int8", **kw
+    ).generate(prompt, samp)
+    grid = make_grid(model=2)
+    eng = InferenceEngineV2(
+        params, model.cfg, grid=grid, quantize_weights="int8", **kw
+    )
+    got = eng.generate(prompt, samp)
+    assert got == solo, (got, solo)
+    # at least one compressed payload is actually split on 'model'
+    from deepspeed_tpu.ops.quantizer import ServingQuant
+
+    qs = [
+        l for l in jax.tree_util.tree_leaves(
+            eng.params, is_leaf=lambda x: isinstance(x, ServingQuant)
+        )
+        if isinstance(l, ServingQuant)
+    ]
+    assert qs, "no quantized leaves survived TP placement"
+    assert any(
+        MODEL_AXIS in jax.tree_util.tree_flatten(
+            tuple(q.q.sharding.spec)
+        )[0]
+        for q in qs
+    )

@@ -127,13 +127,19 @@ def test_quantized_continuous_batching(tiny_model):
     assert all(set(t) == {1, 2} for t in ticks)
 
 
-def test_quantize_rejects_tp(tiny_model):
+def test_quantize_composes_with_tp(tiny_model):
+    """TP x quantized weights construct together (full parity is asserted in
+    test_inference_tp.py::test_tp_serving_with_quantized_weights)."""
     import deepspeed_tpu
 
     model, params = tiny_model
     grid = deepspeed_tpu.initialize_mesh(model=2)
-    with pytest.raises(ValueError, match="tensor-parallel"):
-        InferenceEngineV2(params, model.cfg, grid=grid, quantize_weights="int8")
+    eng = InferenceEngineV2(
+        params, model.cfg, grid=grid, quantize_weights="int8",
+        max_seqs=2, num_blocks=32, block_size=8, prefill_buckets=(16,),
+    )
+    out = eng.generate([3, 1, 4, 1], SamplingParams(max_new_tokens=3))
+    assert len(out) == 3
 
 
 # ---------------------------------------------------------------------------
