@@ -300,6 +300,26 @@ def initialize(
 
     dataloader = None
     if training_data is not None:
+        # curriculum from ANALYZED difficulty indices (reference
+        # data_sampling: DataAnalyzer output feeding DeepSpeedDataSampler):
+        # config data_efficiency.curriculum_learning.data_analysis_path
+        # points at a data_analyzer save dir; the sampler then only admits
+        # samples within the scheduler's current difficulty
+        index_filter = None
+        cl = cfg.data_efficiency.curriculum_learning or {}
+        if (
+            cfg.data_efficiency.enabled
+            and cl.get("enabled")
+            and cl.get("data_analysis_path")
+            and engine.curriculum_scheduler is not None
+        ):
+            from .data.data_analyzer import curriculum_index_filter
+
+            index_filter = curriculum_index_filter(
+                cl["data_analysis_path"],
+                cl.get("difficulty_metric", cl.get("curriculum_type", "seqlen")),
+                engine.curriculum_scheduler,
+            )
         dataloader = DeepSpeedTpuDataLoader(
             training_data,
             micro_batch_size=cfg.train_micro_batch_size_per_gpu,
@@ -307,6 +327,7 @@ def initialize(
             gradient_accumulation_steps=cfg.gradient_accumulation_steps,
             collate_fn=collate_fn,
             seed=cfg.seed,
+            index_filter=index_filter,
         )
     if dataloader is not None:
         engine.training_dataloader = dataloader  # sampler state rides checkpoints

@@ -55,6 +55,7 @@ class DeepSpeedTpuDataLoader:
         collate_fn: Optional[Callable] = None,
         global_batches: bool = True,
         num_epochs: Optional[int] = None,
+        index_filter: Optional[Callable] = None,
     ):
         from ..data.sampler import DeepSpeedDataSampler
 
@@ -78,6 +79,8 @@ class DeepSpeedTpuDataLoader:
             num_epochs=num_epochs if num_epochs is not None else 2**31,
             seed=seed,
             shuffle=shuffle,
+            # curriculum eligibility (data_analyzer.curriculum_index_filter)
+            index_filter=index_filter,
         )
         per_step = micro_batch_size * dp_world_size * self.gas
         # static shapes are a TPU requirement: partial trailing batches are

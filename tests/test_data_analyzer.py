@@ -129,3 +129,69 @@ def test_cli(corpus, tmp_path, capsys):
     assert main(["--data-prefix", prefix, "--save", save, "--workers", "2"]) == 0
     idx = CurriculumIndex(save, "seqlen")
     np.testing.assert_array_equal(np.asarray(idx.index_to_metric), np.sort(lengths))
+
+
+def test_analysis_path_wires_into_initialize(tmp_path):
+    """Config-level loop closure (reference data_sampling): a
+    ``data_analysis_path`` in the curriculum config makes initialize()'s
+    dataloader admit only samples within the scheduler's difficulty."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, get_preset
+
+    # dataset of fixed-shape samples whose difficulty = first token value
+    n = 64
+    rng = np.random.default_rng(0)
+    samples = []
+    for i in range(n):
+        row = rng.integers(1, 250, 17).astype(np.int32)
+        row[0] = i % 32  # the difficulty metric
+        samples.append({"input_ids": row})
+
+    class ListDS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return samples[i]
+
+    save = str(tmp_path / "analysis")
+    DataAnalyzer(
+        ListDS(), num_workers=1, metric_names=["first_token"],
+        metric_functions=[lambda s: int(np.asarray(s["input_ids"])[0])],
+        metric_types=[SINGLE_VALUE], save_path=save,
+    ).run_map_reduce(processes=1)
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=CausalLM(cfg),
+        training_data=ListDS(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "bf16": {"enabled": True},
+            "data_efficiency": {
+                "enabled": True,
+                "curriculum_learning": {
+                    "enabled": True,
+                    "curriculum_type": "first_token",
+                    "data_analysis_path": save,
+                    "min_difficulty": 8,
+                    "max_difficulty": 32,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 100,
+                                        "difficulty_step": 8},
+                },
+            },
+        },
+        mesh=deepspeed_tpu.initialize_mesh(data=8),
+    )
+    # the first epoch's batches must only contain first-token <= 8
+    it = iter(loader)
+    batch = next(it)
+    firsts = np.asarray(batch["input_ids"]).reshape(-1, 17)[:, 0]
+    assert (firsts <= 8).all(), firsts
+    # and the engine still trains on them
+    loss = engine.train_batch(batch)
+    assert np.isfinite(float(loss))
