@@ -97,6 +97,7 @@ def config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
         kv = heads if not hf.get("multi_query", False) else 1
         if hf.get("new_decoder_architecture"):
             kv = hf.get("num_kv_heads", kv)
+        alibi = bool(hf.get("alibi", False))  # falcon-rw variants
         return TransformerConfig(
             vocab_size=hf["vocab_size"], hidden_size=d,
             intermediate_size=hf.get("ffn_hidden_size", 4 * d),
@@ -109,6 +110,8 @@ def config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
             attn_out_bias=bool(hf.get("bias", False)),
             mlp_bias=bool(hf.get("bias", False)),
             tie_embeddings=hf.get("tie_word_embeddings", False),
+            position="alibi" if alibi else "rope",
+            attn_impl="reference",  # alibi needs the bias-capable body
             rope_theta=hf.get("rope_theta", 10_000.0),
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
         )
@@ -168,13 +171,16 @@ def _interleaved_to_half(w: np.ndarray, heads: int, hd: int, rot: int) -> np.nda
     return w.reshape(w.shape[:-2] + (heads * hd,))
 
 
-def _load_family_layers(t, cfg, model_type: str):
+def _load_family_layers(t, cfg, model_type: str, hf_cfg=None):
     """Per-family tensor-name tables -> the init_params layer tree.
     Returns (params, leftovers_consumed_ok).  All torch Linears transpose to
-    ``[in, out]``; gpt2 Conv1D is already ``[in, out]``."""
+    ``[in, out]``; gpt2 Conv1D is already ``[in, out]``.  ``hf_cfg`` carries
+    layout flags that only the raw HF config knows (falcon's
+    ``new_decoder_architecture`` fused-qkv grouping)."""
     L = cfg.num_layers
     d = cfg.hidden_size
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
+    falcon_new_decoder = bool((hf_cfg or {}).get("new_decoder_architecture"))
 
     def take(name):
         if name not in t:
@@ -316,13 +322,52 @@ def _load_family_layers(t, cfg, model_type: str):
 
     if model_type == "falcon":
         p = "transformer.h.{i}."
-        # classic falcon (multi_query): fused [.., (heads+2)*hd] = q heads,
-        # then one k head, one v head
-        qkv_w = stack(p + "self_attention.query_key_value.weight")  # [L, d, (hq+2*hkv)*hd]
-        qkv_w = qkv_w.reshape(L, d, hq + 2 * hkv, hd)
-        wq = qkv_w[:, :, :hq].reshape(L, d, hq * hd)
-        wk = qkv_w[:, :, hq : hq + hkv].reshape(L, d, hkv * hd)
-        wv = qkv_w[:, :, hq + hkv :].reshape(L, d, hkv * hd)
+
+        def split_fused(a: np.ndarray):
+            """Split the trailing fused-qkv dim of ``a`` ([L, ..., fused])
+            into (q [..., hq*hd], k [..., hkv*hd], v [..., hkv*hd]) —
+            shared by the weight ([L, d, fused]) and, on bias-bearing
+            falcon-rw checkpoints, the fused bias ([L, fused])."""
+            lead = a.shape[:-1]
+            if falcon_new_decoder:
+                # new_decoder_architecture (falcon-40b/180b): fused heads
+                # are GROUPED per kv head — [hkv, (g q heads, k, v), hd]
+                # with g = hq // hkv.  Flattened q-head order kv*g+j
+                # matches our GQA mapping (q head h reads kv head h // g),
+                # so a straight reshape-split is exact.
+                g = hq // hkv
+                a = a.reshape(lead + (hkv, g + 2, hd))
+                return (
+                    a[..., :g, :].reshape(lead + (hq * hd,)),
+                    a[..., g, :].reshape(lead + (hkv * hd,)),
+                    a[..., g + 1, :].reshape(lead + (hkv * hd,)),
+                )
+            if hq == hkv:
+                # falcon-rw (multi_query=False): per-head interleaved
+                # [heads, (q, k, v), hd] — the bloom layout, NOT the
+                # q-block/k/v tail split
+                a = a.reshape(lead + (hq, 3, hd))
+                return (
+                    a[..., 0, :].reshape(lead + (hq * hd,)),
+                    a[..., 1, :].reshape(lead + (hq * hd,)),
+                    a[..., 2, :].reshape(lead + (hq * hd,)),
+                )
+            # classic falcon (multi_query): fused [.., (heads+2)*hd] =
+            # q heads, then one k head, one v head
+            if hkv != 1:
+                raise NotImplementedError(
+                    f"falcon fused-qkv split: multi_query layout expects "
+                    f"num_kv_heads == 1, got {hkv} (a grouped checkpoint "
+                    f"must set new_decoder_architecture)"
+                )
+            a = a.reshape(lead + (hq + 2, hd))
+            return (
+                a[..., :hq, :].reshape(lead + (hq * hd,)),
+                a[..., hq, :].reshape(lead + (hd,)),
+                a[..., hq + 1, :].reshape(lead + (hd,)),
+            )
+
+        wq, wk, wv = split_fused(stack(p + "self_attention.query_key_value.weight"))
         layers = {
             "attn": {
                 "wq": wq, "wk": wk, "wv": wv,
@@ -337,6 +382,24 @@ def _load_family_layers(t, cfg, model_type: str):
                 "w_down": stack(p + "mlp.dense_4h_to_h.weight"),
             },
         }
+        if cfg.qkv_bias:
+            # falcon-rw carries biases (config bias=true): the fused qkv
+            # bias splits exactly like the weight's output dim
+            bq, bk, bv = split_fused(
+                stack(p + "self_attention.query_key_value.bias", transpose=False)
+            )
+            layers["attn"].update({"bq": bq, "bk": bk, "bv": bv})
+        if cfg.attn_out_bias:
+            layers["attn"]["bo"] = stack(
+                p + "self_attention.dense.bias", transpose=False
+            )
+        if cfg.mlp_bias:
+            layers["mlp"]["b_up"] = stack(
+                p + "mlp.dense_h_to_4h.bias", transpose=False
+            )
+            layers["mlp"]["b_down"] = stack(
+                p + "mlp.dense_4h_to_h.bias", transpose=False
+            )
         if not cfg.parallel_block:
             layers["mlp_norm"] = {
                 "scale": stack(p + "post_attention_layernorm.weight", transpose=False),
@@ -471,7 +534,7 @@ def load_hf_checkpoint(
     L = cfg.num_layers
 
     if hf_cfg.get("model_type") in _FAMILY_LOADERS:
-        params = _load_family_layers(t, cfg, hf_cfg["model_type"])
+        params = _load_family_layers(t, cfg, hf_cfg["model_type"], hf_cfg=hf_cfg)
         if not cfg.tie_embeddings:
             if "lm_head.weight" in t:
                 params["lm_head"] = {"kernel": t.pop("lm_head.weight").T}

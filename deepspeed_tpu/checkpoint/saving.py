@@ -33,13 +33,34 @@ def _tag(engine, tag: Optional[str]) -> str:
     return tag if tag is not None else f"global_step{engine.global_steps}"
 
 
+def _feeds_loader(prefetch_src, loader) -> bool:
+    """Does the object train_on_loader iterates draw (possibly through
+    wrappers like RepeatingLoader, via their ``.loader`` attribute) from
+    ``loader``?  Decides whether the prefetcher's drained position is the
+    authoritative checkpoint state for this loader."""
+    from ..runtime.dataloader import unwrap_loader_chain
+
+    return any(link is loader for link in unwrap_loader_chain(prefetch_src))
+
+
 def _nvme_dir(path: str) -> str:
     return os.path.join(path, "nvme_state")
+
+
+def _settle_deferred_metrics(engine) -> None:
+    """Deferred async-metrics accounting (runtime/prefetch.py MetricsBuffer)
+    must land before a checkpoint snapshots ``skipped_steps`` — applied
+    HERE, next to the drain logic it mirrors, so direct callers of this
+    module's functions get it too (not only engine.save_checkpoint)."""
+    flush = getattr(engine, "_flush_step_metrics", None)
+    if callable(flush):
+        flush()
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state=None):
     from .engine import AsyncCheckpointEngine, get_checkpoint_engine
 
+    _settle_deferred_metrics(engine)
     ce = get_checkpoint_engine(engine)
     tag = _tag(engine, tag)
     path = os.path.abspath(os.path.join(save_dir, tag))
@@ -65,8 +86,23 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     loader = getattr(engine, "training_dataloader", None)
     if loader is not None and hasattr(loader, "state_dict"):
         # resumable data position (reference: engine checkpoints the
-        # data-sampler consumed_samples the same way)
-        meta["data_sampler"] = loader.state_dict()
+        # data-sampler consumed_samples the same way).  None = the loader
+        # wraps something without a resumable position (RepeatingLoader
+        # over a plain iterable): store nothing rather than a null state.
+        ds_state = loader.state_dict()
+        pf = getattr(engine, "_active_prefetcher", None)
+        if pf is not None and _feeds_loader(
+            getattr(engine, "_prefetch_loader", None), loader
+        ):
+            # mid-iteration save under train_on_loader: the live sampler has
+            # advanced past batches still parked in the prefetch buffer —
+            # record the position of the oldest unconsumed batch so resume
+            # replays exactly (no skipped, no repeated samples)
+            drained = pf.resume_state()
+            if drained is not None:
+                ds_state = drained
+        if ds_state is not None:
+            meta["data_sampler"] = ds_state
     if getattr(engine, "curriculum_scheduler", None) is not None:
         meta["curriculum"] = engine.curriculum_scheduler.get_state()
     if jax.process_index() == 0:
@@ -108,6 +144,7 @@ def load_checkpoint(
 
     from .engine import get_checkpoint_engine
 
+    _settle_deferred_metrics(engine)  # buffered metrics are pre-restore steps
     ce = get_checkpoint_engine(engine)
     ce.wait()  # a pending async save must land before we read
     tag = tag or get_latest_tag(load_dir)
@@ -141,7 +178,11 @@ def load_checkpoint(
     if load_lr_scheduler_states and "lr_scheduler" in meta:
         engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
     loader = getattr(engine, "training_dataloader", None)
-    if loader is not None and hasattr(loader, "load_state_dict") and "data_sampler" in meta:
+    if (
+        loader is not None
+        and hasattr(loader, "load_state_dict")
+        and meta.get("data_sampler") is not None
+    ):
         loader.load_state_dict(meta["data_sampler"])
     if getattr(engine, "curriculum_scheduler", None) is not None and "curriculum" in meta:
         engine.curriculum_scheduler.set_state(meta["curriculum"])

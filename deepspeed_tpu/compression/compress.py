@@ -468,15 +468,41 @@ def make_kd_loss_fn(
     """Engine-ready ``loss_fn(params, batch, rng)`` distilling
     ``teacher_model(teacher_params)`` into the student: task loss blended
     with the KD term.  The teacher forward runs under ``stop_gradient``
-    inside the same jitted step (no second engine needed)."""
-    from ..models.transformer import forward
+    inside the same jitted step (no second engine needed).
+
+    ONE student forward per step: the task cross-entropy is derived from the
+    same logits the KD term consumes (an earlier version re-ran the student
+    through ``student_model.loss_fn`` on top of the logits forward, doubling
+    student compute per KD step).  KD needs the full student logits for the
+    KL regardless, so ``loss_chunk_size`` students pay no more memory here
+    than the pre-fix code (which also materialized them).  The engine's
+    progressive-layer-drop theta (``batch['pld_theta']``) applies to the
+    student forward exactly as ``CausalLM.loss_fn`` would apply it; the
+    teacher always runs all layers."""
+    from ..models.transformer import cross_entropy_loss, forward
 
     t_params = jax.tree_util.tree_map(jax.lax.stop_gradient, teacher_params)
 
     def loss_fn(params, batch, rng=None):
-        task = student_model.loss_fn(params, batch, rng)
-        s_logits, _, _ = forward(params, batch["input_ids"], student_model.cfg)
-        t_logits, _, _ = forward(t_params, batch["input_ids"], teacher_model.cfg)
+        # CausalLM.prepare_batch IS loss_fn's preprocessing (label shift,
+        # segment trim, PLD keep mask) — shared, so the KD task loss can
+        # never silently diverge from what plain training would train on
+        inputs, labels, segment_ids, layer_keep = student_model.prepare_batch(
+            batch, rng
+        )
+        s_cfg = student_model.cfg
+        s_logits, _, s_aux = forward(
+            params, inputs, s_cfg, segment_ids=segment_ids,
+            stack_apply=getattr(student_model, "stack_apply", None),
+            layer_keep=layer_keep,
+        )
+        task = cross_entropy_loss(s_logits, labels)
+        if s_cfg.moe_num_experts > 0:
+            task = task + s_cfg.moe_aux_loss_coef * s_aux / max(s_cfg.num_layers, 1)
+        t_logits, _, _ = forward(
+            t_params, inputs, teacher_model.cfg, segment_ids=segment_ids,
+            stack_apply=getattr(teacher_model, "stack_apply", None),
+        )
         kd = kd_loss(s_logits, jax.lax.stop_gradient(t_logits), temperature)
         return (1.0 - alpha) * task + alpha * kd
 

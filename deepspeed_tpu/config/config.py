@@ -138,6 +138,36 @@ class ZeroConfig:
 
 
 @dataclass
+class TrainDataConfig:
+    """Input-pipeline knobs (runtime/prefetch.py — the latency-hiding input
+    pipeline).
+
+    ``prefetch_depth``: bounded count of global batches collated +
+    ``device_put`` into the engine's batch shardings ahead of the step by a
+    background worker (2 = double buffering; 0 disables prefetch so
+    ``train_on_loader`` degenerates to the synchronous loop).
+
+    ``async_metrics``: keep ``StepMetrics`` as device arrays and defer every
+    host read (fp16 skip accounting, monitor emission, throughput timer
+    sync) to ``steps_per_print`` boundaries or an explicit
+    ``engine.get_last_loss()``.  The flops profiler and
+    ``wall_clock_breakdown`` still request synced reads at their own
+    boundaries regardless.
+    """
+
+    prefetch_depth: int = 2
+    async_metrics: bool = True
+
+    def __post_init__(self):
+        if not 0 <= self.prefetch_depth <= 64:
+            raise ConfigError(
+                f"train_data.prefetch_depth must be in [0, 64] (each slot "
+                f"parks one global batch in device memory), got "
+                f"{self.prefetch_depth}"
+            )
+
+
+@dataclass
 class PrecisionConfig:
     enabled: bool = False
     loss_scale: float = 0.0  # 0 -> dynamic
@@ -526,6 +556,7 @@ class Config:
     hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
     aio: AIOConfig = field(default_factory=AIOConfig)
     nebula: NebulaConfig = field(default_factory=NebulaConfig)
+    train_data: TrainDataConfig = field(default_factory=TrainDataConfig)
 
     # --- derived (filled by finalize) ---
     dp_world_size: int = 1

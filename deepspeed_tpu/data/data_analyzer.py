@@ -284,10 +284,74 @@ class CurriculumDataSampler:
         return np.asarray(batch, np.int64)
 
     def state_dict(self):
-        return {"consumed_samples": self.consumed_samples}
+        return {
+            "consumed_samples": self.consumed_samples,
+            # the full pool position: exact restore regardless of the step
+            # numbering the caller fed next_batch (the replay fallback below
+            # must assume contiguous 1-based steps)
+            "pool_key": None if self._pool_key is None else int(self._pool_key),
+            "pos": int(self._pos),
+        }
 
     def load_state_dict(self, state):
+        """Restore the pool position exactly.
+
+        ``consumed_samples`` alone used to be restored, leaving
+        ``_pos``/``_pool_key`` at their fresh-start values — a resumed run
+        re-drew the current difficulty pool from index 0, repeating samples
+        it had already trained on.  New checkpoints carry the position
+        directly; old ones fall back to a deterministic replay of the
+        difficulty trajectory (valid for the contiguous 1-based step
+        numbering ``next_batch`` documents)."""
+        from .curriculum_scheduler import CURRENT_DIFFICULTY, MIN_DIFFICULTY
+
         self.consumed_samples = int(state["consumed_samples"])
+        self._pool_key, self._pool, self._pos = None, None, 0
+        if "pool_key" in state:
+            key = state["pool_key"]
+            self._pos = int(state.get("pos", 0))
+            if key is not None:
+                self._pool_key = key
+                rng = np.random.default_rng(self.seed + key)
+                self._pool = rng.permutation(self.index.sample_ids_up_to(key))
+                # a warm scheduler that ratcheted past the checkpoint must
+                # rewind with us: update_difficulty skips recomputation at
+                # max difficulty, so a stale high value would stick
+                self.scheduler.set_current_difficulty(key)
+            else:
+                self.scheduler.state[CURRENT_DIFFICULTY] = self.scheduler.state[
+                    MIN_DIFFICULTY
+                ]
+            return
+        # legacy state: replay the trajectory from the beginning (a live
+        # scheduler that already advanced past the checkpointed step would
+        # otherwise replay at its ratcheted difficulty).  After the replay
+        # the scheduler lands at the checkpointed step's difficulty.
+        steps = self.consumed_samples // self.global_batch_size
+        self.scheduler.state[CURRENT_DIFFICULTY] = self.scheduler.state[
+            MIN_DIFFICULTY
+        ]
+        pool_len = 0
+        for step in range(1, steps + 1):
+            difficulty = self.scheduler.update_difficulty(step)
+            if self._pool_key != difficulty:
+                self._pool_key = difficulty
+                # length only — the permuted pool itself is materialized
+                # once below, not per replayed step
+                pool_len = int(
+                    np.searchsorted(
+                        self.index.index_to_metric, difficulty, side="right"
+                    )
+                )
+                self._pos = 0
+            if self._pos + self.global_batch_size > pool_len:
+                self._pos = 0
+            self._pos += self.global_batch_size
+        if self._pool_key is not None:
+            rng = np.random.default_rng(self.seed + self._pool_key)
+            self._pool = rng.permutation(
+                self.index.sample_ids_up_to(self._pool_key)
+            )
 
 
 def main(argv: Optional[List[str]] = None) -> int:

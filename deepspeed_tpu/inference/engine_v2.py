@@ -52,6 +52,25 @@ class InferenceEngineV2:
         quantize_weights: Optional[str] = None,
     ):
         self.cfg = cfg
+        # Families the paged v2 path cannot serve yet must refuse loudly
+        # instead of decoding silently wrong tokens: ALiBi needs a
+        # positional-bias operand in the paged decode kernel, and the
+        # parallel-block layout (falcon/gptj/phi) shares one LN across both
+        # branches while the runner assumes attn_norm/mlp_norm.  Per-family
+        # biases (qkv/o/mlp/head) and bloom's embedding LN ARE applied
+        # (model_runner._attn_out/_ffn/_lm_logits/_embed).
+        if cfg.position == "alibi":
+            raise NotImplementedError(
+                "InferenceEngineV2 cannot serve position='alibi' models: the "
+                "paged decode kernel has no additive positional-bias operand "
+                "yet — use init_inference (the dense v1 engine) instead"
+            )
+        if cfg.parallel_block:
+            raise NotImplementedError(
+                "InferenceEngineV2 cannot serve parallel_block models "
+                "(falcon/gptj/phi layout): the runner wires sequential "
+                "attn_norm/mlp_norm blocks — use init_inference instead"
+            )
         # Quantized-weight serving (reference csrc/fp_quantizer + FP6 blog
         # 1.69-2.65x claim): big matmul kernels stored int8/fp8 with per-
         # output-channel scales; serving_mm applies the scale post-matmul so

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..models.transformer import (
     TransformerConfig,
     _activation,
+    head_bias_vec,
     head_kernel,
     mlp_block,
     norm,
@@ -57,11 +58,44 @@ def _ffn(lw, x, cfg):
         return out
     mlp = lw["mlp"]
     act = _activation(cfg.activation)
+    up = serving_mm(x, mlp["w_up"])
+    if "b_up" in mlp:  # gpt2/opt/phi-style biased MLP
+        up = up + mlp["b_up"]
     if cfg.gated_mlp:
-        h = act(serving_mm(x, mlp["w_gate"])) * serving_mm(x, mlp["w_up"])
+        gate = serving_mm(x, mlp["w_gate"])
+        if "b_gate" in mlp:
+            gate = gate + mlp["b_gate"]
+        h = act(gate) * up
     else:
-        h = act(serving_mm(x, mlp["w_up"]))
-    return serving_mm(h, mlp["w_down"])
+        h = act(up)
+    out = serving_mm(h, mlp["w_down"])
+    if "b_down" in mlp:
+        out = out + mlp["b_down"]
+    return out
+
+
+def _attn_out(lw, x):
+    """o-projection (+ bias when the family carries one)."""
+    out = serving_mm(x, lw["wo"])
+    if "bo" in lw:
+        out = out + lw["bo"]
+    return out
+
+
+def _lm_logits(params, cfg, x):
+    """Final head (+ gptj/phi lm_head bias) in fp32."""
+    logits = serving_mm(x, head_kernel(params, cfg))
+    bias = head_bias_vec(params)
+    if bias is not None:
+        logits = logits + bias
+    return logits.astype(jnp.float32)
+
+
+def _embed(params, cfg, x):
+    """Post-embedding layernorm (bloom-style ``embedding_norm``)."""
+    if cfg.embedding_norm:
+        x = norm(x, params["embed_norm"], cfg.norm, cfg.norm_eps)
+    return x
 
 
 def prefill(
@@ -82,6 +116,7 @@ def prefill(
     positions = jnp.arange(s)[None]
     if cfg.position == "learned":
         x = x + params["pos_embed"]["embedding"][jnp.arange(s)][None].astype(cfg.dtype)
+    x = _embed(params, cfg, x)
     ck, cv = kv_cache
     # python loop over layers: each layer writes its cache page slab.
     # (L is static; unrolled trace is fine for inference graphs).  The KV
@@ -107,15 +142,15 @@ def prefill(
         attn = flash_attention(
             q, k, v, causal=True, logits_soft_cap=cfg.logits_soft_cap
         )
-        attn = serving_mm(attn.reshape(1, s, -1), lw["attn"]["wo"])
+        attn = _attn_out(lw["attn"], attn.reshape(1, s, -1))
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(length - 1, 0, s - 1)]  # [d]
-    logits = serving_mm(last, head_kernel(params, cfg))  # [v]
-    return logits.astype(jnp.float32), (tuple(new_ck), tuple(new_cv))
+    logits = _lm_logits(params, cfg, last)  # [v]
+    return logits, (tuple(new_ck), tuple(new_cv))
 
 
 def prefill_packed(
@@ -147,6 +182,7 @@ def prefill_packed(
         x = x + params["pos_embed"]["embedding"][
             jnp.clip(positions, 0, cfg.max_seq_len - 1)
         ][None].astype(cfg.dtype)
+    x = _embed(params, cfg, x)
     ck, cv = kv_cache
     nb = ck[0].shape[0]
     bs = ck[0].shape[1]
@@ -179,15 +215,15 @@ def prefill_packed(
             q, k, v, causal=True, segment_ids=seg,
             logits_soft_cap=cfg.logits_soft_cap,
         )
-        attn = serving_mm(attn.reshape(1, t, -1), lw["attn"]["wo"])
+        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
-    logits = serving_mm(last, head_kernel(params, cfg))  # [N, v]
-    return logits.astype(jnp.float32), (tuple(new_ck), tuple(new_cv))
+    logits = _lm_logits(params, cfg, last)  # [N, v]
+    return logits, (tuple(new_ck), tuple(new_cv))
 
 
 def decode_step(
@@ -209,6 +245,7 @@ def decode_step(
             jnp.clip(seq_lens, 0, cfg.max_seq_len - 1)
         ]
         x = x + pe[:, None].astype(cfg.dtype)
+    x = _embed(params, cfg, x)
     ck, cv = kv_cache
     new_ck, new_cv = list(ck), list(cv)
     for l in range(cfg.num_layers):
@@ -228,10 +265,10 @@ def decode_step(
             q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
             logits_soft_cap=cfg.logits_soft_cap, mesh=mesh,
         )
-        attn = serving_mm(attn.reshape(b, 1, -1), lw["attn"]["wo"])
+        attn = _attn_out(lw["attn"], attn.reshape(b, 1, -1))
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
         x = x + _ffn(lw, h, cfg).astype(x.dtype)
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    logits = serving_mm(x[:, 0], head_kernel(params, cfg))
-    return logits.astype(jnp.float32), (tuple(new_ck), tuple(new_cv))
+    logits = _lm_logits(params, cfg, x[:, 0])
+    return logits, (tuple(new_ck), tuple(new_cv))

@@ -728,7 +728,12 @@ class CausalLM:
     def apply(self, params, tokens, **kw):
         return forward(params, tokens, self.cfg, **kw)
 
-    def loss_fn(self, params, batch, rng=None):
+    def prepare_batch(self, batch, rng=None):
+        """Batch preprocessing shared by ``loss_fn`` and the KD loss
+        (compression/compress.py make_kd_loss_fn): label shift / segment
+        trim, and the progressive-layer-drop keep mask when the engine
+        injected a traced theta.  Returns (inputs, labels, segment_ids,
+        layer_keep)."""
         tokens = batch["input_ids"]
         segment_ids = batch.get("segment_ids")
         # progressive layer drop: the engine injects a traced per-step theta
@@ -749,6 +754,10 @@ class CausalLM:
             inputs, labels = tokens[:, :-1], tokens[:, 1:]
             if segment_ids is not None:
                 segment_ids = segment_ids[:, :-1]
+        return inputs, labels, segment_ids, layer_keep
+
+    def loss_fn(self, params, batch, rng=None):
+        inputs, labels, segment_ids, layer_keep = self.prepare_batch(batch, rng)
         if self.cfg.loss_chunk_size:
             from ..sequence.cross_entropy import chunked_cross_entropy
 

@@ -55,14 +55,20 @@ class CsvMonitor(Monitor):
             os.makedirs(self.path, exist_ok=True)
 
     def write_events(self, events: List[Event]):
+        # group by label: the engine's deferred-metrics flush delivers a
+        # whole steps_per_print window at once — one open/append per file
+        # per flush, not one per event
+        by_label: dict = {}
         for label, value, step in events:
+            by_label.setdefault(label, []).append((step, value))
+        for label, rows in by_label.items():
             fname = os.path.join(self.path, label.replace("/", "_") + ".csv")
             new = not os.path.exists(fname)
             with open(fname, "a", newline="") as fh:
                 w = csv.writer(fh)
                 if new:
                     w.writerow(["step", label])
-                w.writerow([step, value])
+                w.writerows(rows)
 
 
 class WandbMonitor(Monitor):

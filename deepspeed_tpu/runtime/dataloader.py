@@ -6,12 +6,32 @@ TPU-native counterpart of ``runtime/dataloader.py`` (``DeepSpeedDataLoader``
 ``[gas, global_micro_batch, ...]`` as numpy arrays; the engine's jit scatters
 them across the mesh (each host only materializes its addressable shard via
 ``jax.make_array_from_process_local_data`` on multi-host).
+
+Pipelining contract (runtime/prefetch.py): the sample gather + collate +
+gas-fold in ``__iter__`` is host work that ``engine.train_on_loader`` moves
+onto a background prefetch worker, and ``state_dict()`` read *between*
+``__next__`` calls is exactly the pre-draw position of the next batch —
+restoring it and re-iterating replays the identical batch stream.  That
+snapshot property is what makes mid-epoch checkpointing with prefetched
+batches in flight exact.
 """
 from __future__ import annotations
 
 from typing import Any, Callable, Iterator, Optional, Sequence
 
 import numpy as np
+
+
+def unwrap_loader_chain(loader):
+    """Yield ``loader`` and each ``.loader``-wrapped inner loader
+    (cycle-safe).  THE wrapper-chain traversal shared by the engine's
+    prefetch state capture and the checkpoint drain check — one definition
+    keeps 'drain applies' and 'drain can capture state' in lockstep."""
+    seen = set()
+    while loader is not None and id(loader) not in seen:
+        yield loader
+        seen.add(id(loader))
+        loader = getattr(loader, "loader", None)
 
 
 class RepeatingLoader:
@@ -31,6 +51,20 @@ class RepeatingLoader:
         except StopIteration:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+    # delegate the resumable-position contract so the prefetch pipeline's
+    # checkpoint-safe drain works through the repeating wrapper too
+    def state_dict(self):
+        inner = getattr(self.loader, "state_dict", None)
+        return inner() if callable(inner) else None
+
+    def load_state_dict(self, state) -> None:
+        inner = getattr(self.loader, "load_state_dict", None)
+        if callable(inner) and state is not None:
+            inner(state)
+            # the wrapped epoch iterator has advanced past the restored
+            # position: rebuild it so the next __next__ resumes there
+            self.data_iter = iter(self.loader)
 
 
 class DeepSpeedTpuDataLoader:

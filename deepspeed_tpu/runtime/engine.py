@@ -58,12 +58,63 @@ from ..utils.timer import (
 )
 from . import precision, zero
 from .lr_schedules import LRScheduler, get_lr_schedule_fn
+from .prefetch import DevicePrefetcher, MetricsBuffer, host_scalar
 
 
 def _now() -> float:
     import time
 
     return time.perf_counter()
+
+
+import atexit
+import weakref
+
+# ONE process-wide exit hook draining every live engine's deferred-metrics
+# buffer (bare train_batch loops have no end-of-loop hook; without this,
+# async-buffered tail metrics — monitor rows, fp16 skip counts past the
+# last steps_per_print boundary — would be lost on plain process exit).
+# WeakSet: the hook must never keep engines (and their device state) alive,
+# and per-instance atexit.register would accumulate one closure per engine
+# for the life of the process.
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_EXIT_HOOK_REGISTERED = False
+
+
+def _drain_metrics_at_exit():
+    for engine in list(_LIVE_ENGINES):
+        try:
+            engine._flush_step_metrics()
+        except Exception:  # noqa: BLE001 — backend may be torn down
+            pass
+
+
+def _register_exit_flush(engine) -> None:
+    global _EXIT_HOOK_REGISTERED
+    _LIVE_ENGINES.add(engine)
+    if not _EXIT_HOOK_REGISTERED:
+        _EXIT_HOOK_REGISTERED = True
+        atexit.register(_drain_metrics_at_exit)
+
+
+def _gas_fold(batch, gas: int, micro_global: int):
+    """Fold a flat ``[global_batch, ...]`` pytree into ``[gas, micro, ...]``
+    if it isn't folded already — the ONE folding rule shared by
+    ``train_batch`` and the prefetch placement path.
+
+    ``micro_global`` (= micro_batch * dp) disambiguates the
+    ``micro_global == 1`` corner where a flat batch's leading dim also
+    equals ``gas``: there a folded batch is recognizable by its size-1
+    second axis, while a flat one must still be folded."""
+    x = jax.tree_util.tree_leaves(batch)[0]
+    already_folded = x.shape[0] == gas and (
+        micro_global > 1 or (x.ndim >= 2 and x.shape[1] == 1)
+    )
+    if already_folded:
+        return batch
+    return jax.tree_util.tree_map(
+        lambda v: v.reshape((gas, v.shape[0] // gas) + v.shape[1:]), batch
+    )
 
 
 class TrainState(NamedTuple):
@@ -296,6 +347,12 @@ class DeepSpeedTpuEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self._last_metrics: Optional[StepMetrics] = None
+        # latency-hiding input/step pipeline (runtime/prefetch.py)
+        self._metrics_buffer = MetricsBuffer()
+        self._active_prefetcher: Optional[DevicePrefetcher] = None
+        self._prefetch_loader = None
+        self._prefetch_shardings = None
+        _register_exit_flush(self)
         self.model = None  # attached by initialize() for the flops profiler
         self.training_dataloader = None  # attached by initialize(); its
         # sampler position rides engine checkpoints (checkpoint/saving.py)
@@ -920,13 +977,13 @@ class DeepSpeedTpuEngine:
         """Run one full optimizer step on a global batch shaped
         ``[gas, global_micro_batch, ...]`` (or ``[global_micro_batch, ...]``
         when gradient_accumulation_steps == 1)."""
-        gas = self.config.gradient_accumulation_steps
-        leading = jax.tree_util.tree_leaves(batch)[0].shape[0]
-        if leading != gas:
-            # accept flat [global_batch, ...] and fold into [gas, micro, ...]
-            batch = jax.tree_util.tree_map(
-                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch
-            )
+        # accept flat [global_batch, ...] and fold into [gas, micro, ...]
+        # (a no-op for prefetched batches — _place_batch already folded)
+        batch = _gas_fold(
+            batch,
+            self.config.gradient_accumulation_steps,
+            self.config.train_micro_batch_size_per_gpu * self.config.dp_world_size,
+        )
         if self.curriculum_scheduler is not None:
             # reference: curriculum difficulty advances per global step and
             # (for the seqlen metric) truncates the batch — each distinct
@@ -944,8 +1001,21 @@ class DeepSpeedTpuEngine:
         self.state, metrics = self._get_train_step(batch)(self.state, batch, rng)
         self._last_metrics = metrics
         self.global_steps += 1
-        if self.config.fp16.enabled and bool(metrics.skipped):
-            self.skipped_steps += 1
+        async_metrics = self.config.train_data.async_metrics
+        # ONE metrics path for both modes: buffer the device arrays; the
+        # flush (below, after the timers — outside the measured window,
+        # where the old emission also ran) does skip accounting, the
+        # steps_per_print log line, and monitor emission.  Sync mode
+        # flushes every step (host reads on the critical path, the
+        # historical behavior); async mode defers the flush to
+        # steps_per_print boundaries / get_last_loss / checkpoints so the
+        # loop issues no per-step blocking host read.
+        self._metrics_buffer.append(
+            self.global_steps,
+            metrics,
+            keep_history=self.config.fp16.enabled
+            or (self.monitor is not None and self.monitor.enabled),
+        )
         self.lr_scheduler.step()
         if self.progressive_layer_drop is not None:
             # host-side mirror of the traced theta (monitoring/get_state();
@@ -965,23 +1035,26 @@ class DeepSpeedTpuEngine:
             if (self.config.wall_clock_breakdown or profiling_now)
             else None
         )
-        self.tput_timer.stop(sync_obj=metrics.loss)
-        if (
-            self.config.memory_breakdown
-            and self.global_steps % self.config.steps_per_print == 0
-        ):
+        print_boundary = self.global_steps % self.config.steps_per_print == 0
+        # async mode: the throughput timer stays a dispatch-time sample
+        # except at print boundaries, where the sync makes the *window*
+        # total (and thus avg_samples_per_sec) exact device time
+        self.tput_timer.stop(
+            sync_obj=metrics.loss
+            if (not async_metrics or print_boundary)
+            else None
+        )
+        if self.config.memory_breakdown and print_boundary:
             from ..utils.memory import see_memory_usage
 
             see_memory_usage(f"after step {self.global_steps}", force=True)
-        self._emit_monitor(metrics)
+        if not async_metrics or print_boundary:
+            self._flush_step_metrics()
         if profiling_now:
             # before the wall-clock log below: log(reset=True) zeroes the
             # step timer the profiler reads its latency from
             self._run_flops_profiler(batch)
-        if (
-            self.config.wall_clock_breakdown
-            and self.global_steps % self.config.steps_per_print == 0
-        ):
+        if self.config.wall_clock_breakdown and print_boundary:
             # reference: EngineTimers groups logged per steps_per_print
             self.timers.log(
                 [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER],
@@ -1183,6 +1256,9 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     def eval_batch(self, batch):
         self.flush_nvme_pipeline()
+        # an eval boundary is a natural sync point: settle deferred train
+        # metrics (skip counts, monitor rows) before reporting eval numbers
+        self._flush_step_metrics()
         if self._eval_step is None:
             fn = self.eval_fn or self.loss_fn
 
@@ -1210,7 +1286,14 @@ class DeepSpeedTpuEngine:
         return self.lr_scheduler.get_last_lr()
 
     def get_global_grad_norm(self) -> Optional[float]:
-        return float(self._last_metrics.grad_norm) if self._last_metrics else None
+        """Synced grad norm of the newest step.  An explicit host read of
+        the async-metrics contract (like ``get_last_loss``): flushes the
+        deferred buffer and routes through ``host_scalar`` so the sync
+        surface stays auditable."""
+        if self._last_metrics is None:
+            return None
+        self._flush_step_metrics()
+        return host_scalar(self._last_metrics.grad_norm)
 
     @property
     def loss_scale(self) -> float:
@@ -1239,24 +1322,185 @@ class DeepSpeedTpuEngine:
 
         return memory_breakdown_report(self)
 
-    def _emit_monitor(self, metrics: StepMetrics):
-        if self.global_steps % self.config.steps_per_print == 0:
+    # ------------------------------------------------------------------
+    # latency-hiding input/step pipeline (runtime/prefetch.py)
+    # ------------------------------------------------------------------
+    def _flush_step_metrics(self) -> None:
+        """Host accounting for buffered StepMetrics — THE single emission
+        path for both metric modes: fp16 skip counts, the
+        ``steps_per_print`` log line, monitor events per step in order.
+        Sync mode flushes a one-item buffer every step; async mode flushes
+        a whole window at once (one deferred sync instead of one per
+        step)."""
+        if len(self._metrics_buffer) == 0:
+            return
+        fp16 = self.config.fp16.enabled
+        emit = self.monitor is not None and self.monitor.enabled
+        events = []
+        for step, m in self._metrics_buffer.flush():
+            if fp16 and m.skipped:
+                self.skipped_steps += 1
+            if step % self.config.steps_per_print == 0:
+                log_dist(
+                    f"step={step} loss={m.loss:.4f} "
+                    f"lr={m.lr:.3e} grad_norm={m.grad_norm:.3f}"
+                )
+            if emit:
+                events.extend(
+                    [
+                        ("Train/Samples/train_loss", m.loss, step),
+                        ("Train/Samples/lr", m.lr, step),
+                        ("Train/Samples/loss_scale", m.loss_scale, step),
+                    ]
+                )
+        if events:
+            self.monitor.write_events(events)
+
+    def get_last_loss(self) -> Optional[float]:
+        """Synced scalar loss of the newest completed step.  THE explicit
+        host read of the async-metrics contract: flushes the deferred
+        buffer (skip accounting, logs, monitor) and blocks on the loss."""
+        self._flush_step_metrics()
+        if self._last_metrics is None:
+            return None
+        return host_scalar(self._last_metrics.loss)
+
+    def _place_batch(self, batch):
+        """Gas-fold host-side and ``device_put`` into the fused step's batch
+        shardings.  Runs on the prefetch worker thread, so the H2D transfer
+        for batch k+1 overlaps batch k's device compute instead of paying it
+        at dispatch time."""
+        batch = _gas_fold(
+            batch,
+            self.config.gradient_accumulation_steps,
+            self.config.train_micro_batch_size_per_gpu * self.config.dp_world_size,
+        )
+        if self._prefetch_shardings is None:
+            # NamedShardings depend on leaf rank only, so one plan covers
+            # every step (static shapes are already a TPU requirement)
+            self._prefetch_shardings = self.batch_sharding(batch, batch_dim=1)
+        return jax.device_put(batch, self._prefetch_shardings)
+
+    def train_on_loader(self, data_loader, num_steps: Optional[int] = None):
+        """Iterator-driven fast path: generator over pipelined
+        ``train_batch`` steps.
+
+        A background worker (``train_data.prefetch_depth`` deep, default 2 =
+        double buffering) collates, gas-folds and ``device_put``-places batches
+        ahead of the step; together with ``train_data.async_metrics`` the
+        loop dispatches step k+1 while step k executes on device.  Yields
+        the per-step loss as a device array — call ``get_last_loss()`` for
+        a synced value.
+
+        Clean shutdown + exactness: worker exceptions re-raise here at the
+        point in the stream where they occurred; on generator exit (or
+        ``close()``), prefetched-but-unconsumed batches are returned to the
+        loader's sampler position via ``load_state_dict``, and a checkpoint
+        saved mid-iteration records that same drained position — resume
+        replays without skipping or repeating samples."""
+        from .dataloader import unwrap_loader_chain
+
+        from ..data.data_analyzer import CurriculumDataSampler
+
+        def _draws_at_live_difficulty(link) -> bool:
+            sampler = getattr(link, "data_sampler", None)
+            return (
+                getattr(sampler, "index_filter", None) is not None
+                or isinstance(sampler, CurriculumDataSampler)
+                or isinstance(link, CurriculumDataSampler)
+            )
+
+        depth = self.config.train_data.prefetch_depth
+        if depth > 0 and any(
+            _draws_at_live_difficulty(link)
+            for link in unwrap_loader_chain(data_loader)
+        ):
+            # difficulty-driven sampling reads (and CurriculumDataSampler
+            # mutates) the LIVE scheduler at draw time; a worker running
+            # ahead would evaluate it at a stale/racing difficulty —
+            # exactness wins: run synchronously
             log_dist(
-                f"step={self.global_steps} loss={float(metrics.loss):.4f} "
-                f"lr={float(metrics.lr):.3e} grad_norm={float(metrics.grad_norm):.3f}"
+                "train_on_loader: curriculum-driven sampling active — "
+                "prefetch disabled for this loader (the eligible pool must "
+                "be built at the consuming step's difficulty)"
             )
-        if self.monitor is not None and self.monitor.enabled:
-            self.monitor.write_events(
-                [
-                    ("Train/Samples/train_loss", float(metrics.loss), self.global_steps),
-                    ("Train/Samples/lr", float(metrics.lr), self.global_steps),
-                    (
-                        "Train/Samples/loss_scale",
-                        float(metrics.loss_scale),
-                        self.global_steps,
-                    ),
-                ]
+            depth = 0
+        if depth == 0:
+            try:
+                n = 0
+                for batch in data_loader:
+                    yield self.train_batch(batch)
+                    n += 1
+                    if num_steps is not None and n >= num_steps:
+                        return
+                return
+            finally:
+                # tail steps past the last steps_per_print boundary still
+                # owe their skip accounting / monitor rows
+                self._flush_step_metrics()
+        if self._active_prefetcher is not None:
+            raise RuntimeError(
+                "train_on_loader is already active on this engine; close the "
+                "previous generator first"
             )
+        # each invocation may carry a different batch pytree structure;
+        # _place_batch re-derives the sharding plan from its first batch
+        self._prefetch_shardings = None
+        # find the resumable-position owner by walking wrapper ``.loader``
+        # chains (RepeatingLoader etc.) — the SAME chain save_checkpoint's
+        # drain check walks, so "drain applies" and "drain can capture
+        # state" never diverge
+        state_owner = next(
+            (
+                link
+                for link in unwrap_loader_chain(data_loader)
+                if callable(getattr(link, "state_dict", None))
+            ),
+            None,
+        )
+        state_fn = (
+            state_owner.state_dict if state_owner is not None else None
+        )
+        pf = DevicePrefetcher(
+            iter(data_loader),
+            self._place_batch,
+            depth=depth,
+            state_fn=state_fn,
+        )
+        self._active_prefetcher = pf
+        self._prefetch_loader = data_loader
+        try:
+            n = 0
+            for dev_batch in pf:
+                yield self.train_batch(dev_batch)
+                n += 1
+                if num_steps is not None and n >= num_steps:
+                    return
+        finally:
+            stopped = pf.close()
+            resume = pf.resume_state()
+            self._active_prefetcher = None
+            self._prefetch_loader = None
+            if (
+                stopped
+                and resume is not None
+                and callable(getattr(state_owner, "load_state_dict", None))
+            ):
+                # return prefetched-but-unconsumed batches to the sampler
+                # that owns the position (the state_dict provider above)
+                state_owner.load_state_dict(resume)
+            elif not stopped:
+                # a worker stuck in a slow draw could advance the sampler
+                # AFTER a restore here — leave the position untouched
+                # rather than restore a value the zombie would clobber
+                logger.warning(
+                    "prefetch worker did not stop within timeout; loader "
+                    "position left as-is (checkpoint it only after the "
+                    "worker exits)"
+                )
+            # tail steps past the last steps_per_print boundary still owe
+            # their skip accounting / monitor rows
+            self._flush_step_metrics()
 
     # checkpointing is provided by deepspeed_tpu.checkpoint; engine methods
     # delegate so the reference API shape survives.
@@ -1264,6 +1508,8 @@ class DeepSpeedTpuEngine:
         from ..checkpoint.saving import save_checkpoint as _save
 
         self.flush_nvme_pipeline()
+        # deferred metrics settle inside saving.save_checkpoint (shared
+        # with direct callers of the saving module)
 
         return _save(self, save_dir, tag=tag, client_state=client_state or {})
 

@@ -70,12 +70,22 @@ class _Timer:
         return (self._elapsed / self._count * 1000.0) if self._count else 0.0
 
 
+# Diagnostic: every device fence a timer issues lands here.  The async-
+# metrics tests read it to assert the steady-state training loop stays
+# sync-free between steps_per_print boundaries.
+TIMER_SYNCS = {"count": 0}
+
+
 def _block(obj, hard: bool = False):
     """Device sync.  ``hard`` additionally forces a 1-element host fetch:
     block_until_ready alone is not a reliable fence on every backend (the
     axon tunnel returns immediately).  Hard syncs serialize dispatch, so
     only measurement paths (wall_clock_breakdown, the flops profiler)
-    request them — the throughput timer stays a soft fence."""
+    request them — the throughput timer stays a soft fence.  With
+    ``train_data.async_metrics`` the engine requests the throughput fence
+    only at ``steps_per_print`` boundaries, so the window total stays exact
+    device time while per-step stops are dispatch-only samples."""
+    TIMER_SYNCS["count"] += 1
     try:
         import jax
 
@@ -164,9 +174,21 @@ class ThroughputTimer:
                 self.total_elapsed += self.step_elapsed
                 self.history.append(self.step_elapsed)
             if report_speed and self.global_steps % self.steps_per_output == 0:
+                # window-average, not the boundary step alone: with the
+                # engine's async metrics only the boundary stop carries a
+                # device fence, so its raw step_elapsed absorbs the whole
+                # window's drained device time (~steps_per_output x one
+                # step).  The window mean is the true per-step figure in
+                # both sync and async modes.
+                window = self.history[-self.steps_per_output:]
+                avg_ms = (
+                    sum(window) / len(window) * 1000.0
+                    if window
+                    else self.step_elapsed * 1000.0
+                )
                 log_dist(
                     f"step={self.global_steps}, samples/sec={self.avg_samples_per_sec():.2f}, "
-                    f"step time={self.step_elapsed * 1000:.1f} ms"
+                    f"step time (window avg)={avg_ms:.1f} ms"
                 )
             self.step_elapsed = 0.0
 

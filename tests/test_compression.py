@@ -406,3 +406,43 @@ def test_init_compression_accepts_full_reference_schema():
     })
     assert mgr.layer_reduction["keep_number_layer"] == 2
     assert not mgr.any_weight_transform  # only disabled techniques
+
+
+def test_kd_loss_single_student_forward(monkeypatch):
+    """The KD loss must run the student ONCE per step: the task CE is
+    derived from the same logits the KL term consumes (an earlier version
+    re-ran the student through loss_fn, doubling student compute)."""
+    import deepspeed_tpu.models.transformer as tr
+    from deepspeed_tpu.compression import make_kd_loss_fn
+    from deepspeed_tpu.compression.compress import kd_loss
+    from deepspeed_tpu.models import CausalLM, get_preset
+    from deepspeed_tpu.models.transformer import cross_entropy_loss
+
+    cfg = get_preset("tiny", max_seq_len=16, num_layers=2)
+    teacher = CausalLM(cfg)
+    student = CausalLM(cfg)
+    t_params = teacher.init_params(jax.random.PRNGKey(0))
+
+    calls = {"n": 0}
+    real_forward = tr.forward
+
+    def counting_forward(*a, **kw):
+        calls["n"] += 1
+        return real_forward(*a, **kw)
+
+    monkeypatch.setattr(tr, "forward", counting_forward)
+    loss_fn = make_kd_loss_fn(
+        student, teacher, t_params, alpha=0.3, temperature=2.0
+    )
+    rng = np.random.default_rng(3)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (2, 17)).astype(np.int32)}
+    blended = loss_fn(t_params, batch)
+    assert calls["n"] == 2, f"expected 1 student + 1 teacher forward, got {calls['n']}"
+
+    # exactness: blended loss == (1-a)*CE(student logits) + a*KD(same logits)
+    inputs, labels = batch["input_ids"][:, :-1], batch["input_ids"][:, 1:]
+    logits, _, _ = real_forward(t_params, inputs, cfg)
+    expect = 0.7 * cross_entropy_loss(logits, labels) + 0.3 * kd_loss(
+        logits, logits, 2.0
+    )
+    np.testing.assert_allclose(float(blended), float(expect), rtol=1e-5)

@@ -98,6 +98,64 @@ def test_curriculum_sampler_follows_schedule(corpus, tmp_path):
     assert st["consumed_samples"] == 12 * 4
 
 
+def test_curriculum_sampler_resume_exact(corpus, tmp_path):
+    """state_dict/load_state_dict round-trip mid-run: the restored sampler
+    must continue with the exact batches the original would have drawn —
+    a bare consumed_samples restore used to restart the difficulty pool at
+    index 0 and repeat samples."""
+    prefix, _ = corpus
+    ds = MMapIndexedDataset(prefix)
+    save = str(tmp_path / "analysis")
+    DataAnalyzer(ds, num_workers=1, save_path=save).run_map_reduce(processes=1)
+
+    def mk():
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen",
+            "min_difficulty": 12,
+            "max_difficulty": 70,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 8},
+        })
+        return CurriculumDataSampler(
+            CurriculumIndex(save, "seqlen"), sched, global_batch_size=4, seed=0
+        )
+
+    # checkpoint at several points, incl. mid-pool and right after a
+    # difficulty change rebuilt the pool; exercise both the direct
+    # pool_key/pos restore and the legacy consumed_samples-only replay
+    for stop in (1, 3, 5, 8):
+        ref = mk()
+        for step in range(1, stop + 1):
+            ref.next_batch(step)
+        st = ref.state_dict()
+        legacy = {"consumed_samples": st["consumed_samples"]}
+        expect = [ref.next_batch(s) for s in range(stop + 1, stop + 5)]
+
+        for snapshot in (st, legacy):
+            res = mk()
+            res.load_state_dict(snapshot)
+            got = [res.next_batch(s) for s in range(stop + 1, stop + 5)]
+            for e, g in zip(expect, got):
+                np.testing.assert_array_equal(g, e)
+
+    # rewind into a WARM sampler: the scheduler has ratcheted past the
+    # checkpoint — load_state_dict must replay the original trajectory,
+    # not the advanced difficulty
+    warm = mk()
+    for step in range(1, 13):
+        warm.next_batch(step)
+    ref = mk()
+    for step in range(1, 4):
+        ref.next_batch(step)
+    st = ref.state_dict()
+    expect = [ref.next_batch(s) for s in range(4, 8)]
+    warm.load_state_dict(st)
+    got = [warm.next_batch(s) for s in range(4, 8)]
+    for e, g in zip(expect, got):
+        np.testing.assert_array_equal(g, e)
+
+
 def test_index_filter_plugs_into_data_sampler(corpus, tmp_path):
     prefix, lengths = corpus
     ds = MMapIndexedDataset(prefix)
@@ -131,7 +189,7 @@ def test_cli(corpus, tmp_path, capsys):
     np.testing.assert_array_equal(np.asarray(idx.index_to_metric), np.sort(lengths))
 
 
-def test_analysis_path_wires_into_initialize(tmp_path):
+def test_analysis_path_wires_into_initialize(tmp_path, monkeypatch):
     """Config-level loop closure (reference data_sampling): a
     ``data_analysis_path`` in the curriculum config makes initialize()'s
     dataloader admit only samples within the scheduler's difficulty."""
@@ -195,3 +253,18 @@ def test_analysis_path_wires_into_initialize(tmp_path):
     # and the engine still trains on them
     loss = engine.train_batch(batch)
     assert np.isfinite(float(loss))
+    # train_on_loader must fall back to the synchronous path here: the
+    # index_filter reads the LIVE scheduler difficulty, which a prefetch
+    # worker running ahead would evaluate stale.  Probe the fallback
+    # directly — constructing a prefetcher at all IS the bug.
+    import deepspeed_tpu.runtime.engine as eng_mod
+
+    def _no_prefetcher(*a, **k):
+        raise AssertionError(
+            "DevicePrefetcher constructed for a curriculum index_filter "
+            "loader — the synchronous fallback regressed"
+        )
+
+    monkeypatch.setattr(eng_mod, "DevicePrefetcher", _no_prefetcher)
+    losses = [float(l) for l in engine.train_on_loader(loader, num_steps=2)]
+    assert np.isfinite(losses).all()

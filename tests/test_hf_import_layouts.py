@@ -1,0 +1,182 @@
+"""Falcon fused-qkv layout splits (no transformers dependency).
+
+HF falcon checkpoints fuse qkv in THREE different layouts depending on
+config flags; each must map to our wq/wk/wv exactly:
+
+- ``multi_query`` (falcon-7b classic): [q heads..., k, v]
+- neither flag (falcon-rw): per-head interleaved [head, (q, k, v), hd]
+- ``new_decoder_architecture`` (falcon-40b/180b): grouped per kv head
+  [kv, (g q heads, k, v), hd] with g = num_heads // num_kv_heads
+
+The expected splits below are built with explicit index loops, independent
+of the vectorized reshape under test.
+"""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.hf_import import _load_family_layers, config_from_hf
+
+D, HEADS, L = 8, 4, 1
+HD = D // HEADS
+
+
+def _hf_cfg(**kw):
+    base = {
+        "model_type": "falcon", "vocab_size": 32, "hidden_size": D,
+        "num_hidden_layers": L, "num_attention_heads": HEADS,
+        "parallel_attn": True, "bias": False,
+    }
+    base.update(kw)
+    return base
+
+
+def _tensors(fused_out):
+    """Synthetic checkpoint: fused qkv cell [o, i] = o * 100 + i, so every
+    output column is identifiable after any reshuffle."""
+    t = {}
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        fused = (
+            np.arange(fused_out)[:, None] * 100 + np.arange(D)[None, :]
+        ).astype(np.float32)
+        t[p + "self_attention.query_key_value.weight"] = fused
+        t[p + "self_attention.dense.weight"] = np.zeros((D, D), np.float32)
+        t[p + "input_layernorm.weight"] = np.ones((D,), np.float32)
+        t[p + "input_layernorm.bias"] = np.zeros((D,), np.float32)
+        t[p + "mlp.dense_h_to_4h.weight"] = np.zeros((4 * D, D), np.float32)
+        t[p + "mlp.dense_4h_to_h.weight"] = np.zeros((D, 4 * D), np.float32)
+    t["transformer.word_embeddings.weight"] = np.zeros((32, D), np.float32)
+    t["transformer.ln_f.weight"] = np.ones((D,), np.float32)
+    t["transformer.ln_f.bias"] = np.zeros((D,), np.float32)
+    return t
+
+
+def _col(o):
+    """Our-[d, out] column for fused output row ``o`` of the synthetic."""
+    return (np.arange(D) + o * 100).astype(np.float32)
+
+
+def _split(hf):
+    cfg = config_from_hf(hf)
+    hkv = cfg.num_kv_heads
+    g_plus = {"q": cfg.num_heads, "kv": hkv}
+    fused_out = (cfg.num_heads + 2 * hkv) * HD
+    if hf.get("new_decoder_architecture"):
+        fused_out = hkv * (cfg.num_heads // hkv + 2) * HD
+    elif not hf.get("multi_query", False):
+        fused_out = 3 * cfg.num_heads * HD
+    params = _load_family_layers(_tensors(fused_out), cfg, "falcon", hf_cfg=hf)
+    a = params["layers"]["attn"]
+    return cfg, a["wq"][0], a["wk"][0], a["wv"][0]
+
+
+def test_falcon_multi_query_split():
+    cfg, wq, wk, wv = _split(_hf_cfg(multi_query=True))
+    assert cfg.num_kv_heads == 1
+    for h in range(HEADS):
+        for e in range(HD):
+            np.testing.assert_array_equal(wq[:, h * HD + e], _col(h * HD + e))
+    for e in range(HD):
+        np.testing.assert_array_equal(wk[:, e], _col(HEADS * HD + e))
+        np.testing.assert_array_equal(wv[:, e], _col((HEADS + 1) * HD + e))
+
+
+def test_falcon_rw_interleaved_split():
+    """multi_query=False without new_decoder_architecture is the per-head
+    [q, k, v] interleave (the bloom layout) — the classic q-block split
+    would scramble it."""
+    cfg, wq, wk, wv = _split(_hf_cfg(multi_query=False))
+    assert cfg.num_kv_heads == HEADS
+    for h in range(HEADS):
+        for e in range(HD):
+            np.testing.assert_array_equal(
+                wq[:, h * HD + e], _col((h * 3 + 0) * HD + e)
+            )
+            np.testing.assert_array_equal(
+                wk[:, h * HD + e], _col((h * 3 + 1) * HD + e)
+            )
+            np.testing.assert_array_equal(
+                wv[:, h * HD + e], _col((h * 3 + 2) * HD + e)
+            )
+
+
+def test_falcon_new_decoder_grouped_split():
+    """new_decoder_architecture groups fused heads per kv head:
+    [kv, (g q heads, k, v), hd]; flattened q-head order kv*g+j must match
+    our GQA mapping (q head h reads kv head h // g)."""
+    hkv = 2
+    cfg, wq, wk, wv = _split(
+        _hf_cfg(new_decoder_architecture=True, num_kv_heads=hkv,
+                multi_query=False)
+    )
+    assert cfg.num_kv_heads == hkv
+    g = HEADS // hkv
+    for kv in range(hkv):
+        base = kv * (g + 2) * HD
+        for j in range(g):
+            h = kv * g + j  # flattened q-head index
+            for e in range(HD):
+                np.testing.assert_array_equal(
+                    wq[:, h * HD + e], _col(base + j * HD + e)
+                )
+        for e in range(HD):
+            np.testing.assert_array_equal(
+                wk[:, kv * HD + e], _col(base + g * HD + e)
+            )
+            np.testing.assert_array_equal(
+                wv[:, kv * HD + e], _col(base + (g + 1) * HD + e)
+            )
+
+
+def test_falcon_grouped_without_flag_refuses():
+    """A grouped checkpoint whose config lost new_decoder_architecture must
+    refuse instead of loading silently wrong weights."""
+    hf = _hf_cfg(multi_query=False)
+    cfg = config_from_hf(hf).replace(num_kv_heads=2)
+    with pytest.raises(NotImplementedError, match="new_decoder_architecture"):
+        _load_family_layers(
+            _tensors((HEADS + 2 * 2) * HD), cfg, "falcon", hf_cfg=hf
+        )
+
+
+def test_falcon_rw_bias_import():
+    """bias=true falcon-rw checkpoints carry fused qkv + dense + mlp biases:
+    the importer must split/load them (a config that declares qkv_bias but
+    loads no bq would KeyError at the first forward)."""
+    hf = _hf_cfg(multi_query=False, bias=True, parallel_attn=False)
+    cfg = config_from_hf(hf)
+    assert cfg.qkv_bias and cfg.attn_out_bias and cfg.mlp_bias
+    fused_out = 3 * HEADS * HD
+    t = _tensors(fused_out)
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        t[p + "self_attention.query_key_value.bias"] = (
+            np.arange(fused_out) * 1000.0
+        ).astype(np.float32)
+        t[p + "self_attention.dense.bias"] = np.full((D,), 7.0, np.float32)
+        t[p + "mlp.dense_h_to_4h.bias"] = np.full((4 * D,), 8.0, np.float32)
+        t[p + "mlp.dense_4h_to_h.bias"] = np.full((D,), 9.0, np.float32)
+        t[p + "post_attention_layernorm.weight"] = np.ones((D,), np.float32)
+        t[p + "post_attention_layernorm.bias"] = np.zeros((D,), np.float32)
+    params = _load_family_layers(t, cfg, "falcon", hf_cfg=hf)
+    a = params["layers"]["attn"]
+    # bias splits with the same per-head interleave as the weight
+    for h in range(HEADS):
+        for e in range(HD):
+            assert a["bq"][0][h * HD + e] == (h * 3 + 0) * HD * 1000.0 + e * 1000.0
+            assert a["bk"][0][h * HD + e] == (h * 3 + 1) * HD * 1000.0 + e * 1000.0
+            assert a["bv"][0][h * HD + e] == (h * 3 + 2) * HD * 1000.0 + e * 1000.0
+    np.testing.assert_array_equal(a["bo"][0], np.full((D,), 7.0))
+    np.testing.assert_array_equal(
+        params["layers"]["mlp"]["b_up"][0], np.full((4 * D,), 8.0)
+    )
+    np.testing.assert_array_equal(
+        params["layers"]["mlp"]["b_down"][0], np.full((D,), 9.0)
+    )
+
+
+def test_falcon_rw_alibi_config():
+    cfg = config_from_hf(_hf_cfg(multi_query=False, alibi=True))
+    assert cfg.position == "alibi" and cfg.attn_impl == "reference"
+    cfg = config_from_hf(_hf_cfg(multi_query=True))
+    assert cfg.position == "rope"

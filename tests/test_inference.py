@@ -308,3 +308,57 @@ def test_v2_moe_matches_v1_dense():
                         SamplingParams(max_new_tokens=n))[0].tolist()
     paged = v2.generate(prompt, SamplingParams(max_new_tokens=n))
     assert dense == paged, (dense, paged)
+
+
+def test_v2_refuses_unsupported_families():
+    """v2 must refuse the families it would decode silently wrong: ALiBi
+    (no positional-bias operand in the paged kernel) and parallel-block
+    layouts (shared LN across both branches)."""
+    from deepspeed_tpu.models.transformer import init_params
+
+    for preset, match in (("tiny_alibi", "alibi"),
+                          ("tiny_parallel", "parallel_block")):
+        cfg = get_preset(preset, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg=cfg)
+        with pytest.raises(NotImplementedError, match=match):
+            InferenceEngineV2(params, cfg, max_seqs=1, num_blocks=8,
+                              block_size=8)
+
+
+@pytest.mark.parametrize("base", ["tiny_gpt2", "tiny"])
+def test_v2_serves_biased_family_exactly(base):
+    """Biases (qkv/o/mlp incl. gated b_gate/head) and the embedding LN must
+    flow through the paged v2 path — they used to be silently dropped
+    (zero-init biases masked it; randomize them so a drop flips the greedy
+    argmax).  ``tiny_gpt2`` covers the non-gated MLP, ``tiny`` the gated."""
+    import jax.tree_util as jtu
+
+    from deepspeed_tpu.runtime.zero import path_str
+
+    cfg = get_preset(base, dtype=jnp.float32).replace(
+        qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+        head_bias=True, tie_embeddings=False, embedding_norm=True,
+    )
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    bias_names = {"bq", "bk", "bv", "bo", "b_gate", "b_up", "b_down", "bias"}
+
+    def noisy(kp, leaf):
+        p = path_str(kp)
+        if p.split("/")[-1] in bias_names:
+            seed = sum(map(ord, p)) % (2**31)
+            return leaf + 0.05 * jax.random.normal(
+                jax.random.PRNGKey(seed), leaf.shape, leaf.dtype
+            )
+        return leaf
+
+    params = jtu.tree_map_with_path(noisy, params)
+    v1 = init_inference(model, params)
+    v2 = InferenceEngineV2(params, cfg, max_seqs=2, num_blocks=64,
+                           block_size=8, prefill_buckets=(16, 32))
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    n = 6
+    dense = v1.generate(np.asarray([prompt], np.int32),
+                        SamplingParams(max_new_tokens=n))[0].tolist()
+    paged = v2.generate(prompt, SamplingParams(max_new_tokens=n))
+    assert dense == paged, (dense, paged)
