@@ -7,3 +7,4 @@ from .engine import InferenceEngine, init_inference  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
 from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
 from .sampling import SamplingParams, sample  # noqa: F401
+from .scheduler import ServeRequest, ServeScheduler  # noqa: F401

@@ -50,6 +50,9 @@ class InferenceEngineV2:
         offload_weights: bool = False,
         grid=None,
         quantize_weights: Optional[str] = None,
+        enable_prefix_caching: bool = False,
+        prefill_chunk: Optional[int] = None,
+        kv_watermark: float = 0.0625,
     ):
         self.cfg = cfg
         # Families the paged v2 path cannot serve yet must refuse loudly
@@ -148,7 +151,23 @@ class InferenceEngineV2:
         self.block_size = block_size
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.max_pages = -(-self.max_seq_len // block_size)
-        self.mgr = StateManager(num_blocks, block_size, max_seqs)
+        # serving knobs (ServeScheduler reads these): ``enable_prefix_caching``
+        # turns on refcounted block reuse across prompts sharing a prefix,
+        # ``prefill_chunk`` bounds prompt tokens per scheduler tick (Dynamic
+        # SplitFuse), ``kv_watermark`` is the pool fraction admission keeps
+        # free so decode growth cannot deadlock against a full pool
+        self.enable_prefix_caching = enable_prefix_caching
+        self.prefill_chunk = prefill_chunk
+        self.kv_watermark = kv_watermark
+        self.mgr = StateManager(num_blocks, block_size, max_seqs,
+                                enable_prefix_caching=enable_prefix_caching)
+        self._scheduler = None
+        self.stats = {
+            "prefill_tokens_dispatched": 0,  # real prompt tokens run (not pad)
+            "prefill_dispatches": 0,
+            "table_uploads": 0,  # H2D copies of the block-table mirror
+            "decode_ticks": 0,
+        }
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
         # SplitFuse-style token budget: multiple prompts share one prefill
         # dispatch as long as their total length fits the budget (clamped to
@@ -171,8 +190,12 @@ class InferenceEngineV2:
         self._burst_cap = 64  # step_n accumulator rows (doubles on demand)
         # host-side block-table mirror: rows update as pure numpy writes and
         # upload ONCE per tick — per-sequence device .at[].set calls cost one
-        # dispatch each, which dominated decode latency
+        # dispatch each, which dominated decode latency.  Dirty tracking on
+        # top: ticks where no sequence grew or swapped a page reuse the
+        # cached device copy and skip the H2D transfer entirely.
         self._tables_np = np.full((max_seqs, self.max_pages), -1, np.int32)
+        self._tables_dev = None
+        self._tables_dirty = True
 
         # params are explicit jit arguments — closing over them would inline
         # every weight into the HLO as a constant (huge programs, no donation)
@@ -189,6 +212,26 @@ class InferenceEngineV2:
             # second device round trip per tick
             t, k, p = sampling_triple
             return sample(logits, SamplingParams(t, k, p), rng), kv
+
+        def packed_ctx_impl(params, tokens, seg, pos, pack_pages, last_idx,
+                            ctx_tables, ctx_lens, kv, rng, sampling_triple):
+            """Context-aware variant: suffix tokens attend over each
+            sequence's cached KV pages (prefix-cache hits, chunked-prefill
+            continuation chunks).  Cold packs stay on ``packed_impl``."""
+            logits, kv = model_runner.prefill_packed_ctx(
+                params, cfg_, tokens, seg, pos, pack_pages, last_idx,
+                ctx_tables, ctx_lens, kv
+            )
+            t, k, p = sampling_triple
+            return sample(logits, SamplingParams(t, k, p), rng), kv
+
+        def cow_impl(kv, src, dst):
+            """Copy-on-write page clone: dst pages get src's contents in
+            every layer pool (donated, so the pool updates in place)."""
+            ck, cv = kv
+            ck = tuple(c.at[dst].set(c[src]) for c in ck)
+            cv = tuple(c.at[dst].set(c[src]) for c in cv)
+            return ck, cv
 
         mesh_ = self._mesh
 
@@ -234,6 +277,13 @@ class InferenceEngineV2:
                 packed_impl, donate_argnums=(6,), static_argnums=(8,),
                 out_shardings=(rep, self._kv_shardings),
             )
+            self._packed_prefill_ctx_jit = jax.jit(
+                packed_ctx_impl, donate_argnums=(8,), static_argnums=(10,),
+                out_shardings=(rep, self._kv_shardings),
+            )
+            self._cow_jit = jax.jit(
+                cow_impl, donate_argnums=(0,), out_shardings=self._kv_shardings,
+            )
             self._decode_jit = jax.jit(
                 decode_impl, donate_argnums=(2, 5, 6), static_argnums=(7,),
                 out_shardings=(rep, rep, rep, self._kv_shardings),
@@ -248,6 +298,12 @@ class InferenceEngineV2:
                 jax.jit(packed_impl, donate_argnums=(6,), static_argnums=(8,)),
                 kv_rest_idx=5,
             )
+            self._packed_prefill_ctx_jit = self._wrap_offload(
+                jax.jit(packed_ctx_impl, donate_argnums=(8,),
+                        static_argnums=(10,)),
+                kv_rest_idx=7,
+            )
+            self._cow_jit = jax.jit(cow_impl, donate_argnums=(0,))
             self._decode_jit = self._wrap_offload(
                 jax.jit(
                     decode_impl, donate_argnums=(2, 5, 6), static_argnums=(7,)
@@ -261,6 +317,11 @@ class InferenceEngineV2:
                 ),
                 kv_rest_idx=4,
             )
+
+        def _cow(src: int, dst: int) -> None:
+            self.kv = self._cow_jit(self.kv, jnp.int32(src), jnp.int32(dst))
+
+        self.mgr.cow_hook = _cow
 
     # -- ZeRO-Inference helpers ---------------------------------------------
     @staticmethod
@@ -327,8 +388,10 @@ class InferenceEngineV2:
 
     # -- scheduling queries (reference engine_v2.py:158/:184) --------------
     def query(self, uid: int) -> Tuple[int, int]:
-        """(max admissible new tokens, free blocks) — admission info."""
-        free = self.mgr.allocator.free_blocks
+        """(max admissible new tokens, allocatable blocks) — admission info.
+        Counts evictable cached blocks: the prefix cache retires pages to an
+        LRU instead of the free list, and allocation reclaims them."""
+        free = self.mgr.allocator.available_blocks
         return free * self.block_size, free
 
     @classmethod
@@ -392,7 +455,7 @@ class InferenceEngineV2:
         blocks = sum(-(-p // self.block_size) for p in prompt_lens)
         return (
             len(self.mgr.seqs) + len(prompt_lens) <= self.mgr.max_seqs
-            and blocks <= self.mgr.allocator.free_blocks
+            and blocks <= self.mgr.allocator.available_blocks
         )
 
     # -- serving API -------------------------------------------------------
@@ -406,8 +469,14 @@ class InferenceEngineV2:
 
         Prompts are packed into shared dispatches under ``prefill_budget``
         tokens (SplitFuse-style; reference ragged_wrapper atoms) — N short
-        prompts cost one forward pass, not N."""
-        out: Dict[int, int] = {}
+        prompts cost one forward pass, not N.
+
+        Compat wrapper: this is the all-or-nothing admission path and raises
+        ``RuntimeError`` when KV blocks or slots run out.  Load that may
+        exceed capacity belongs on ``self.scheduler`` (``submit()`` queues
+        instead of throwing, chunks long prompts, preempts under pressure).
+        With ``enable_prefix_caching`` the admit matches cached prefix
+        blocks and only the suffix is dispatched."""
         token_lists = [list(map(int, toks)) for toks in token_lists]
         # validate the WHOLE request before admitting anything: a mid-loop
         # failure must not leave earlier prompts admitted with never-written
@@ -424,91 +493,148 @@ class InferenceEngineV2:
                 f"({sum(len(t) for t in token_lists)} tokens): "
                 "out of KV blocks/slots"
             )
-        admitted = []
+        entries = []
         for uid, toks in zip(uids, token_lists):
             seq = self.mgr.admit(uid, toks)
             self.mgr.ensure_capacity(seq, 0)
-            admitted.append(seq)
+            entries.append((seq, seq.seen_tokens, len(seq.tokens)))
+        return self.prefill_entries(entries, sampling)
 
+    def prefill_entries(self, entries, sampling: SamplingParams) -> Dict[int, int]:
+        """Prefill ``entries`` = [(seq, start, end)] token ranges, splitting
+        into packs under ``prefill_budget``; returns {uid: first_token} for
+        every entry whose range completes its prompt (``end == len(tokens)``
+        — mid-prompt chunks write KV but sample nothing).  ``start`` must be
+        page-aligned: it is either a prefix-cache hit length or a prior
+        chunk boundary, both block-granular by construction."""
+        out: Dict[int, int] = {}
+        bs = self.block_size
         pack: List = []
         pack_len = 0
-        bs = self.block_size
-        for seq in admitted:
-            # page-aligned packing: each prompt starts at a block boundary
-            # so prefill KV lands as page-granular scatters
-            n = -(-len(seq.tokens) // bs) * bs
+        for entry in entries:
+            seq, start, end = entry
+            if start % bs:
+                raise ValueError(
+                    f"prefill start {start} not page-aligned (bs {bs})"
+                )
+            n = -(-(end - start) // bs) * bs
             if pack and pack_len + n > self.prefill_budget:
                 self._run_packed_prefill(pack, sampling, out)
                 pack, pack_len = [], 0
-            pack.append(seq)
+            pack.append(entry)
             pack_len += n
         if pack:
             self._run_packed_prefill(pack, sampling, out)
         return out
 
-    def _run_packed_prefill(self, seqs, sampling, out: Dict[int, int]) -> None:
-        """One packed-prefill dispatch for ``seqs`` (model_runner.prefill_packed).
+    def _run_packed_prefill(self, entries, sampling, out: Dict[int, int]) -> None:
+        """One packed-prefill dispatch for ``entries`` = [(seq, start, end)].
 
-        Each prompt starts at a PAGE boundary of the pack buffer (segment-0
+        Each suffix starts at a PAGE boundary of the pack buffer (segment-0
         gap padding between prompts): KV then writes as one page-granular
         scatter per layer instead of a per-token scatter, which the TPU
-        serializes (~100 ms/2048-token pack measured)."""
+        serializes (~100 ms/2048-token pack measured).  Cold packs (all
+        starts 0) take the flash-kernel fast path; any non-zero start
+        switches the pack to the context-aware dispatch that attends over
+        cached pages."""
         bs = self.block_size
-        total = sum(-(-len(s.tokens) // bs) * bs for s in seqs)
+        total = sum(-(-(end - start) // bs) * bs for _, start, end in entries)
         t_pad = _bucket(total, self.prefill_buckets)
         if t_pad % bs:
             raise ValueError(
                 f"prefill bucket {t_pad} must be a multiple of block_size {bs}"
             )
+        use_ctx = any(start > 0 for _, start, _ in entries)
         tokens = np.zeros(t_pad, np.int32)
         seg = np.zeros(t_pad, np.int32)
         pos = np.zeros(t_pad, np.int32)
         pack_pages = np.full(t_pad // bs, -1, np.int32)
         last_idx = np.full(self.mgr.max_seqs, -1, np.int32)
+        ctx_tables = np.full((self.mgr.max_seqs, self.max_pages), -1, np.int32)
+        ctx_lens = np.zeros(self.mgr.max_seqs, np.int32)
         cur = 0
-        for j, s in enumerate(seqs):
-            n = len(s.tokens)
-            tokens[cur : cur + n] = s.tokens
+        for j, (s, start, end) in enumerate(entries):
+            n = end - start
+            tokens[cur : cur + n] = s.tokens[start:end]
             seg[cur : cur + n] = j + 1
-            pos[cur : cur + n] = np.arange(n)
+            pos[cur : cur + n] = np.arange(start, end)
             n_pages = -(-n // bs)
+            first_page = start // bs
             pack_pages[cur // bs : cur // bs + n_pages] = np.asarray(
-                s.blocks[:n_pages]
+                s.blocks[first_page : first_page + n_pages]
             )
-            last_idx[j] = cur + n - 1
+            if end == len(s.tokens):  # completes the prompt -> sample
+                last_idx[j] = cur + n - 1
+            ctx_tables[j, : len(s.blocks)] = s.blocks
+            ctx_lens[j] = start
             cur += n_pages * bs  # next prompt starts page-aligned
         self._rng, sub = jax.random.split(self._rng)
-        sampled, self.kv = self._packed_prefill_jit(
-            self.params, jnp.asarray(tokens), jnp.asarray(seg), jnp.asarray(pos),
-            jnp.asarray(pack_pages), jnp.asarray(last_idx),
-            self.kv, sub, (sampling.temperature, sampling.top_k, sampling.top_p),
+        triple = (sampling.temperature, sampling.top_k, sampling.top_p)
+        if use_ctx:
+            sampled, self.kv = self._packed_prefill_ctx_jit(
+                self.params, jnp.asarray(tokens), jnp.asarray(seg),
+                jnp.asarray(pos), jnp.asarray(pack_pages),
+                jnp.asarray(last_idx), jnp.asarray(ctx_tables),
+                jnp.asarray(ctx_lens), self.kv, sub, triple,
+            )
+        else:
+            sampled, self.kv = self._packed_prefill_jit(
+                self.params, jnp.asarray(tokens), jnp.asarray(seg),
+                jnp.asarray(pos), jnp.asarray(pack_pages),
+                jnp.asarray(last_idx), self.kv, sub, triple,
+            )
+        self.stats["prefill_tokens_dispatched"] += sum(
+            end - start for _, start, end in entries
         )
-        next_tokens = np.asarray(sampled)
-        for j, s in enumerate(seqs):
-            tok = int(next_tokens[j])
-            s.seen_tokens = len(s.tokens)
-            s.tokens.append(tok)
-            self._set_block_table(s)
-            out[s.uid] = tok
+        self.stats["prefill_dispatches"] += 1
+        next_tokens = None
+        for j, (s, start, end) in enumerate(entries):
+            s.seen_tokens = end
+            if end == len(s.tokens):
+                if next_tokens is None:
+                    next_tokens = np.asarray(sampled)
+                tok = int(next_tokens[j])
+                s.tokens.append(tok)
+                self._set_block_table(s)
+                out[s.uid] = tok
+            self.mgr.update_hashes(s)
 
     def _set_block_table(self, seq) -> None:
         row = self._tables_np[seq.slot]
-        row[:] = -1
-        row[: len(seq.blocks)] = seq.blocks
+        new = np.full(self.max_pages, -1, np.int32)
+        new[: len(seq.blocks)] = seq.blocks
+        if not np.array_equal(row, new):
+            row[:] = new
+            self._tables_dirty = True
 
-    def step(self, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
-        """One batched decode tick over all active sequences; returns the
-        next token per uid (sequences at their stop token are skipped)."""
-        active_seqs = [s for s in self.mgr.active if not s.done]
-        if not active_seqs:
-            return {}
+    def _tables_device(self):
+        """Device copy of the block-table mirror, re-uploaded only on ticks
+        where some sequence grew or swapped a page (dirty tracking) — the
+        [max_seqs, max_blocks] H2D copy every tick was pure waste on
+        steady-state decode.  Safe to cache: no decode jit donates the
+        tables argument, and jnp.array always copies (the numpy mirror
+        mutates in place)."""
+        if self._tables_dirty or self._tables_dev is None:
+            self._tables_dev = jnp.array(self._tables_np)
+            self._tables_dirty = False
+            self.stats["table_uploads"] += 1
+        return self._tables_dev
+
+    def _decode_tick(self, active_seqs, sampling: SamplingParams) -> Dict[int, int]:
+        """One batched decode dispatch over ``active_seqs`` only (other
+        tracked sequences keep their KV untouched — the scheduler decodes
+        its own running set without side-driving ``put()``-admitted ones).
+        Appends the sampled token per sequence; stop/length handling is the
+        caller's job."""
         B = self.mgr.max_seqs
         tokens = np.zeros(B, np.int32)
         seq_lens = np.zeros(B, np.int32)
         active = np.zeros(B, bool)
         for s in active_seqs:
-            # grow pages for the token being written this tick
+            # grow pages for the token being written this tick; the COW
+            # guard clones the target page first if it is somehow shared
             self.mgr.ensure_capacity(s, 1)
+            self.mgr.ensure_writable(s, s.cur_len - 1)
             self._set_block_table(s)
             tokens[s.slot] = s.tokens[-1]
             seq_lens[s.slot] = s.cur_len - 1  # KV position of the new token
@@ -516,18 +642,29 @@ class InferenceEngineV2:
         self._rng, sub = jax.random.split(self._rng)
         sampled, _, _, self.kv = self._decode_jit(
             self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
-            # copy: jnp.asarray can alias the numpy mirror zero-copy on CPU,
-            # and the mirror mutates in place next tick
-            jnp.array(self._tables_np), jnp.asarray(active), self.kv,
+            self._tables_device(), jnp.asarray(active), self.kv,
             sub, (sampling.temperature, sampling.top_k, sampling.top_p),
         )
+        self.stats["decode_ticks"] += 1
         next_tokens = np.asarray(sampled)
         out = {}
         for s in active_seqs:
             tok = int(next_tokens[s.slot])
             s.tokens.append(tok)
             s.seen_tokens = s.cur_len - 1
+            self.mgr.update_hashes(s)
             out[s.uid] = tok
+        return out
+
+    def step(self, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
+        """One batched decode tick over all active sequences; returns the
+        next token per uid (sequences at their stop token are skipped)."""
+        active_seqs = [s for s in self.mgr.active if not s.done]
+        if not active_seqs:
+            return {}
+        out = self._decode_tick(active_seqs, sampling)
+        for s in active_seqs:
+            tok = out[s.uid]
             if sampling.stop_token is not None and tok == sampling.stop_token:
                 s.done = True
             if s.cur_len >= self.max_seq_len:
@@ -568,6 +705,7 @@ class InferenceEngineV2:
         active = np.zeros(B, bool)
         for s in active_seqs:
             self.mgr.ensure_capacity(s, n)
+            self.mgr.ensure_writable(s, s.cur_len - 1)
             self._set_block_table(s)
             base_lens[s.slot] = s.cur_len - 1
             tokens0[s.slot] = s.tokens[-1]
@@ -581,7 +719,7 @@ class InferenceEngineV2:
         # per-tick outputs (holding every tick's token array alive was
         # measured to stretch ticks from ~14 ms to 20-70 ms); the burst
         # buffer accumulates rows on device and is fetched once.
-        tables = jnp.array(self._tables_np)
+        tables = self._tables_device()
         active_j = jnp.asarray(active)
         tokens_dev = jnp.asarray(tokens0)
         lens_dev = jnp.asarray(base_lens)
@@ -609,6 +747,7 @@ class InferenceEngineV2:
                 s.done = True
             s.tokens.extend(row)
             s.seen_tokens = s.cur_len - 1
+            self.mgr.update_hashes(s)
             if s.cur_len >= self.max_seq_len:
                 s.done = True
             out[s.uid] = s.tokens[-1]
@@ -618,20 +757,33 @@ class InferenceEngineV2:
         for uid in uids:
             self.mgr.release(uid)
 
+    # -- serving scheduler --------------------------------------------------
+    @property
+    def scheduler(self):
+        """Lazily-built ``ServeScheduler`` bound to this engine: queueing
+        admission (``submit`` never throws on capacity), chunked prefill,
+        watermark headroom, preemption-by-recompute.  Scheduler-managed
+        sequences and direct ``put()``/``step()`` sequences share the KV
+        pool but tick independently."""
+        if self._scheduler is None:
+            from .scheduler import ServeScheduler
+
+            self._scheduler = ServeScheduler(
+                self, prefill_chunk=self.prefill_chunk,
+                kv_watermark=self.kv_watermark,
+            )
+        return self._scheduler
+
     # -- convenience (v1-style generate) -----------------------------------
     def generate(
         self, prompt_tokens: Sequence[int], sampling: SamplingParams = SamplingParams()
     ) -> List[int]:
-        uid = max(self.mgr.seqs, default=0) + 1
-        first = self.put([uid], [prompt_tokens], sampling)[uid]
-        n = len(prompt_tokens)
-        while True:
-            seq = self.mgr.seqs[uid]
-            if seq.done or seq.cur_len - n >= sampling.max_new_tokens:
-                break
-            self.step(sampling)
-        toks = self.mgr.seqs[uid].tokens[n:]
-        self.flush([uid])
-        if sampling.stop_token is not None and toks and toks[-1] == sampling.stop_token:
-            toks = toks[:-1]
-        return toks[: sampling.max_new_tokens]
+        """Single-prompt convenience: submits through the scheduler, so it
+        rides the same admission/chunked-prefill/decode tick as real load
+        and no longer side-drives other active sequences via bare ``step()``
+        calls (scheduler ticks only touch scheduler-managed sequences)."""
+        sched = self.scheduler
+        uid = sched.next_uid()
+        sched.submit(uid, prompt_tokens, sampling)
+        sched.run(wait_for=[uid])
+        return sched.pop_result(uid)

@@ -27,7 +27,12 @@ from ..models.transformer import (
 )
 from ..ops.pallas.flash_attention import flash_attention
 from ..ops.quantizer import serving_mm
-from .paged import paged_attention_decode, write_decode_kv, write_prefill_kv
+from .paged import (
+    paged_attention_decode,
+    paged_attention_packed_ctx,
+    write_decode_kv,
+    write_prefill_kv,
+)
 
 Params = Any
 
@@ -214,6 +219,77 @@ def prefill_packed(
         attn = flash_attention(
             q, k, v, causal=True, segment_ids=seg,
             logits_soft_cap=cfg.logits_soft_cap,
+        )
+        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
+        x = x + attn.astype(x.dtype)
+        h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
+    logits = _lm_logits(params, cfg, last)  # [N, v]
+    return logits, (tuple(new_ck), tuple(new_cv))
+
+
+def prefill_packed_ctx(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [T] int32 — suffix tokens packed at PAGE-aligned starts
+    segment_ids: jnp.ndarray,  # [T] int32 — 1-based per prompt, 0 = padding
+    positions: jnp.ndarray,  # [T] int32 — ABSOLUTE position (start offset baked in)
+    pack_pages: jnp.ndarray,  # [T/bs] int32 — destination page per bs-chunk (-1 pad)
+    last_idx: jnp.ndarray,  # [N] int32 — buffer index of each prompt's last token (-1 pad)
+    ctx_tables: jnp.ndarray,  # [N, P] int32 — block table per segment (-1 pad)
+    ctx_lens: jnp.ndarray,  # [N] int32 — cached-context length per segment
+    kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+):
+    """``prefill_packed`` generalized to token SUFFIXES: each packed segment
+    starts at a per-sequence offset (``ctx_lens``) and attends over its
+    pre-existing KV pages (``ctx_tables``) for positions below the offset
+    plus the causal in-pack segment.  RoPE/learned positions come from the
+    absolute ``positions``.  This is the one model-runner capability both
+    prefix-cache-hit prefill and Dynamic-SplitFuse chunked prefill ride on;
+    segments with offset 0 and the no-context pack stay byte-identical to
+    ``prefill_packed`` (the engine dispatches there for speed).  Returns
+    (logits [N, vocab], new caches); rows of ``last_idx`` that are -1
+    (segment's prompt not yet complete — mid-chunk) yield garbage logits the
+    engine never consumes.
+    """
+    t = tokens.shape[0]
+    x = params["embed"]["embedding"][tokens][None].astype(cfg.dtype)  # [1,T,d]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"]["embedding"][
+            jnp.clip(positions, 0, cfg.max_seq_len - 1)
+        ][None].astype(cfg.dtype)
+    x = _embed(params, cfg, x)
+    ck, cv = kv_cache
+    nb = ck[0].shape[0]
+    bs = ck[0].shape[1]
+    n_chunks = t // bs
+    safe_pages = jnp.where(pack_pages >= 0, pack_pages, nb)
+    pos2 = positions[None]
+    new_ck, new_cv = list(ck), list(cv)
+    for l in range(cfg.num_layers):
+        lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(lw["attn"], h, cfg)
+        if cfg.position == "rope":
+            q = rope(q, pos2, cfg.rope_theta)
+            k = rope(k, pos2, cfg.rope_theta)
+        new_ck[l] = new_ck[l].at[safe_pages].set(
+            k[0].reshape(n_chunks, bs, *k.shape[2:]).astype(new_ck[l].dtype),
+            mode="drop",
+        )
+        new_cv[l] = new_cv[l].at[safe_pages].set(
+            v[0].reshape(n_chunks, bs, *v.shape[2:]).astype(new_cv[l].dtype),
+            mode="drop",
+        )
+        # context positions (< ctx_lens) read from the written pools; the
+        # pack's own freshly-written pages are masked out by ctx_lens, so
+        # passing the post-write pool is safe and mirrors decode_step
+        attn = paged_attention_packed_ctx(
+            q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
+            ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
         x = x + attn.astype(x.dtype)

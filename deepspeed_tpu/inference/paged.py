@@ -70,6 +70,72 @@ def write_decode_kv(cache_layer, kv, block_table, positions, active):
     return cache_layer.at[sentinel, off].set(kv.astype(cache_layer.dtype), mode="drop")
 
 
+def paged_attention_packed_ctx(
+    q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
+    scale=None, logits_soft_cap=None,
+):
+    """Packed-prefill attention where each pack segment ALSO attends to its
+    sequence's cached KV pages (positions below its start offset) — the
+    model-runner capability that prefix caching and chunked prefill both
+    ride on.
+
+    q/k/v [T, h, hd] — the packed suffix tokens (page-aligned segments);
+    segment_ids [T] int32, 1-based per prompt, 0 = padding;
+    cache_*_layer [num_blocks, bs, hkv, hd] — pools WITH this pack's pages
+    already written (the in-pack positions are masked out by ``ctx_lens``);
+    ctx_tables [N, P] int32 — block table per segment row (-1 padded);
+    ctx_lens [N] int32 — cached-context length per segment (start offset).
+
+    One softmax spans [cached context | in-pack causal segment], keys in
+    position order, so a suffix prefill over cached context is numerically
+    the same reduction as the cold full-prompt prefill.  Dense fallback body
+    (gathers all P pages per segment, O(T * P * bs) logits) — ground truth
+    for a future chunked-prefill Pallas kernel; the packed no-context fast
+    path stays on ``flash_attention``.
+    """
+    t, hq, hd = q.shape
+    nb, bs, hkv, _ = cache_k_layer.shape
+    n, p = ctx_tables.shape
+    rep = hq // hkv
+    scale = scale if scale is not None else float(hd) ** -0.5
+    seg_row = jnp.clip(segment_ids - 1, 0, n - 1)  # [T] pack row per token
+
+    safe = jnp.clip(ctx_tables, 0, nb - 1)
+    ck = repeat_kv(cache_k_layer[safe].reshape(n, p * bs, hkv, hd), rep)
+    cv = repeat_kv(cache_v_layer[safe].reshape(n, p * bs, hkv, hd), rep)
+    ck_tok = jnp.take(ck, seg_row, axis=0)  # [T, Lc, hq, hd]
+    cv_tok = jnp.take(cv, seg_row, axis=0)
+
+    qf = q.astype(jnp.float32)
+    logits_ctx = jnp.einsum("tqd,tkqd->tqk", qf, ck_tok.astype(jnp.float32))
+    logits_ctx = logits_ctx * scale
+    kp = repeat_kv(k[None], rep)[0].astype(jnp.float32)  # [T, hq, hd]
+    vp = repeat_kv(v[None], rep)[0]
+    logits_pack = jnp.einsum("tqd,kqd->tqk", qf, kp) * scale  # [T, hq, T]
+    if logits_soft_cap is not None:
+        logits_ctx = logits_soft_cap * jnp.tanh(logits_ctx / logits_soft_cap)
+        logits_pack = logits_soft_cap * jnp.tanh(logits_pack / logits_soft_cap)
+
+    neg = jnp.finfo(jnp.float32).min
+    ctx_ok = (jnp.arange(p * bs)[None, :] < ctx_lens[seg_row][:, None]) \
+        & (segment_ids > 0)[:, None]  # [T, Lc]
+    logits_ctx = jnp.where(ctx_ok[:, None, :], logits_ctx, neg)
+    # packed order == position order within each segment, so causality by
+    # buffer index + segment equality is exact (same rule as prefill_packed)
+    idx = jnp.arange(t)
+    pack_ok = (idx[:, None] >= idx[None, :]) \
+        & (segment_ids[:, None] == segment_ids[None, :])  # [T, T]
+    logits_pack = jnp.where(pack_ok[:, None, :], logits_pack, neg)
+
+    probs = jax.nn.softmax(
+        jnp.concatenate([logits_ctx, logits_pack], axis=-1), axis=-1
+    )
+    pc, pp = probs[..., : p * bs], probs[..., p * bs:]
+    out = jnp.einsum("tqk,tkqd->tqd", pc, cv_tok.astype(jnp.float32)) \
+        + jnp.einsum("tqk,kqd->tqd", pp, vp.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def paged_attention_decode(
     q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
     logits_soft_cap=None, mesh=None,

@@ -1,51 +1,206 @@
-"""Ragged-batching state: blocked KV allocator, sequence descriptors,
-state manager.
+"""Ragged-batching state: refcounted blocked KV allocator with a prefix
+cache, sequence descriptors, state manager.
 
 Port of the reference inference-v2 host-side design — the clean abstractions
 SURVEY §7 says to keep: ``BlockedAllocator``
 (inference/v2/ragged/blocked_allocator.py), ``DSSequenceDescriptor``
-(sequence_descriptor.py), ``DSStateManager`` (ragged_manager.py:19).  All
-host-side Python; device state is the paged KV cache (paged.py).
+(sequence_descriptor.py), ``DSStateManager`` (ragged_manager.py:19) — grown
+with vLLM-style prefix caching: blocks are refcounted, FULL blocks carry a
+content key chained on their parent block, a new prompt reuses any cached
+prefix run of matching blocks, and refcount-0 keyed blocks retire to an LRU
+instead of the free list (evicted only when allocation demands it).  All
+host-side Python; device state is the paged KV cache (paged.py) — the one
+device interaction is the copy-on-write hook the engine installs so a
+shared page is cloned before anyone writes into it.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
-import numpy as np
+
+def block_key(parent_block: Optional[int], tokens: Tuple[int, ...]):
+    """Exact content key of one FULL KV block: the PARENT BLOCK's id (whose
+    cached pages encode the entire preceding context) + this block's token
+    window.  Identity-chained rather than hash-chained: dict lookup compares
+    keys by full equality, so a FALSE prefix match is impossible — Python's
+    64-bit tuple hash is collision-constructible, which is why vLLM moved
+    its prefix-cache keys to sha256; chaining on the concrete parent block
+    gets the same exactness with no digest.  The cost is that evicting a
+    parent invalidates its cached descendants (their keys name a block id
+    that may be reused for different content) — the allocator cascades
+    eviction through ``_children`` for exactly that reason."""
+    return (parent_block, tokens)
 
 
 class BlockedAllocator:
-    """Fixed pool of KV blocks managed as a free list
-    (reference: blocked_allocator.py — same int-linked-list design)."""
+    """Fixed pool of KV blocks managed as a refcounted free list plus an LRU
+    of retired-but-cached blocks (reference: blocked_allocator.py int free
+    list; the refcount/hash/LRU growth is the prefix-cache layer).
+
+    Block lifecycle::
+
+        free -> allocated (refcount 1) -> [shared: refcount k > 1]
+             -> refcount 0 -> cached LRU (if it carries a content key,
+                              pages intact, revivable by ``lookup``+``ref``)
+                           -> free list (if unkeyed)
+        cached LRU -> evicted (key dropped, descendants' keys cascade) when
+                      ``allocate`` outruns the free list
+
+    ``free_blocks`` counts only the free list; admission logic should use
+    ``available_blocks`` (free + evictable cached).
+    """
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
             raise ValueError(f"need at least one block, got {num_blocks}")
         self._num_blocks = num_blocks
-        self._free = list(range(num_blocks))
+        self._free: List[int] = list(range(num_blocks))
+        self._refs: List[int] = [0] * num_blocks
+        self._key_of: Dict[int, object] = {}  # block -> content key
+        self._by_key: Dict[object, int] = {}  # content key -> block
+        self._parent_of: Dict[int, int] = {}  # keyed block -> parent block
+        self._children: Dict[int, set] = {}  # parent block -> keyed children
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # refcount-0 cached
+        self.evictions = 0
+        self.registrations = 0  # successful register() calls (cache version)
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
     @property
+    def cached_blocks(self) -> int:
+        return len(self._lru)
+
+    @property
+    def available_blocks(self) -> int:
+        """Immediately allocatable: free list + evictable cached blocks."""
+        return len(self._free) + len(self._lru)
+
+    @property
     def total_blocks(self) -> int:
         return self._num_blocks
 
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
+    def _check(self, block: int) -> None:
+        if not 0 <= block < self._num_blocks:
+            raise ValueError(f"invalid block id {block}")
+
     def allocate(self, n: int) -> List[int]:
-        if n > len(self._free):
-            raise RuntimeError(f"cannot allocate {n} blocks ({len(self._free)} free)")
-        out, self._free = self._free[:n], self._free[n:]
+        if n > self.available_blocks:
+            raise RuntimeError(
+                f"cannot allocate {n} blocks ({self.available_blocks} available)"
+            )
+        out: List[int] = []
+        while len(out) < n:
+            if self._free:
+                b = self._free.pop()  # LIFO: O(1), and recently-freed pages
+            else:  # are the warmest
+                b = self._evict_one()
+            self._refs[b] = 1
+            out.append(b)
         return out
 
+    def _evict_one(self) -> int:
+        """Drop the least-recently-used cached block, cascading its key AND
+        every cached descendant's key: a descendant's key names this block
+        id as its parent, and once the id is reused for other content a
+        lookup through it would serve wrong pages."""
+        b, _ = self._lru.popitem(last=False)
+        self._drop_key(b)
+        self.evictions += 1
+        return b
+
+    def _drop_key(self, root: int) -> None:
+        stack = [root]
+        while stack:
+            x = stack.pop()
+            key = self._key_of.pop(x, None)
+            if key is not None and self._by_key.get(key) == x:
+                del self._by_key[key]
+            p = self._parent_of.pop(x, None)
+            if p is not None:
+                self._children.get(p, set()).discard(x)
+            stack.extend(self._children.pop(x, ()))
+            # a de-keyed refcount-0 descendant is dead cache: straight to
+            # the free list (the root itself is the caller's to hand out)
+            if x != root and self._refs[x] == 0 and x in self._lru:
+                del self._lru[x]
+                self._free.append(x)
+
+    def ref(self, block: int) -> None:
+        """Take a reference on an allocated or cached block."""
+        self._check(block)
+        if self._refs[block] == 0:
+            if block not in self._lru:
+                raise ValueError(f"cannot ref free block {block}")
+            del self._lru[block]  # revive from the cache
+        self._refs[block] += 1
+
     def free(self, blocks: List[int]) -> None:
+        """Drop one reference per block; last reference retires the block to
+        the cached LRU (keyed) or the free list (unkeyed)."""
         for b in blocks:
-            if not 0 <= b < self._num_blocks:
-                raise ValueError(f"invalid block id {b}")
-            if b in self._free:
+            self._check(b)
+            if self._refs[b] <= 0:
                 raise ValueError(f"double free of block {b}")
-        self._free.extend(blocks)
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                if b in self._key_of:
+                    self._lru[b] = None
+                    self._lru.move_to_end(b)
+                else:
+                    self._free.append(b)
+
+    def register(self, block: int, key, parent: Optional[int] = None) -> bool:
+        """Publish ``block`` as holding the content ``key`` (a FULL block),
+        chained under ``parent`` for eviction cascading.  First writer wins:
+        a duplicate key keeps the existing mapping."""
+        self._check(block)
+        if self._refs[block] <= 0:
+            raise ValueError(f"cannot register unowned block {block}")
+        if block in self._key_of or key in self._by_key:
+            return False
+        self._key_of[block] = key
+        self._by_key[key] = block
+        if parent is not None:
+            self._parent_of[block] = parent
+            self._children.setdefault(parent, set()).add(block)
+        self.registrations += 1
+        return True
+
+    def key_of(self, block: int):
+        """The published content key of ``block`` (None if unkeyed)."""
+        return self._key_of.get(block)
+
+    def lookup(self, key) -> Optional[int]:
+        """Block currently holding content ``key`` (caller must ``ref`` it)."""
+        return self._by_key.get(key)
+
+    def audit(self) -> None:
+        """Invariant check for tests: every block is in exactly one of
+        {free, cached LRU, active (refcount > 0)} and the key maps agree."""
+        free = set(self._free)
+        lru = set(self._lru)
+        active = {b for b in range(self._num_blocks) if self._refs[b] > 0}
+        assert not (free & lru), f"free/lru overlap: {free & lru}"
+        assert not (free & active), f"free/active overlap: {free & active}"
+        assert not (lru & active), f"lru/active overlap: {lru & active}"
+        assert free | lru | active == set(range(self._num_blocks)), "leaked blocks"
+        assert all(self._refs[b] == 0 for b in free | lru)
+        for b, key in self._key_of.items():
+            assert self._by_key.get(key) == b
+        for key, b in self._by_key.items():
+            assert self._key_of.get(b) == key
+        assert set(self._lru) <= set(self._key_of), "unkeyed block in LRU"
+        for p, kids in self._children.items():
+            for c in kids:
+                assert self._parent_of.get(c) == p and c in self._key_of
 
 
 @dataclass
@@ -59,6 +214,8 @@ class SequenceDescriptor:
     seen_tokens: int = 0  # tokens whose KV is already in the cache
     tokens: List[int] = field(default_factory=list)  # full token history
     done: bool = False
+    cached_tokens: int = 0  # prefix tokens served from the block cache
+    hashes: List[object] = field(default_factory=list)  # chained full-block keys
 
     @property
     def cur_len(self) -> int:
@@ -67,14 +224,33 @@ class SequenceDescriptor:
 
 class StateManager:
     """Owns the allocator + uid->descriptor map and the block arithmetic
-    (reference: ragged_manager.py DSStateManager)."""
+    (reference: ragged_manager.py DSStateManager).
 
-    def __init__(self, num_blocks: int, block_size: int, max_seqs: int):
+    With ``enable_prefix_caching`` the manager also drives the reuse layer:
+    ``admit`` matches the prompt's leading FULL blocks against the
+    allocator's hash table (refcount sharing, no KV recompute),
+    ``update_hashes`` publishes blocks as they fill, and ``ensure_writable``
+    copy-on-writes a shared block before a sequence writes into it
+    (``cow_hook(src, dst)`` — installed by the engine — performs the device
+    page copy).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
+                 enable_prefix_caching: bool = False):
         self.block_size = block_size
         self.allocator = BlockedAllocator(num_blocks)
         self.max_seqs = max_seqs
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(max_seqs))
+        self.enable_prefix_caching = enable_prefix_caching
+        self.cow_hook: Optional[Callable[[int, int], None]] = None
+        self.prompt_tokens_total = 0
+        self.cached_prompt_tokens = 0
+        self.cow_copies = 0
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
 
     def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
         have = len(seq.blocks) * self.block_size
@@ -83,7 +259,29 @@ class StateManager:
 
     def can_admit(self, prompt_len: int) -> bool:
         blocks = -(-prompt_len // self.block_size)
-        return bool(self._free_slots) and blocks <= self.allocator.free_blocks
+        return bool(self._free_slots) and blocks <= self.allocator.available_blocks
+
+    def _match_prefix(self, tokens: List[int]) -> Tuple[List[int], List[object]]:
+        """Longest cached run of FULL leading blocks for ``tokens``.  Capped
+        at ``(len-1)//block_size`` blocks so at least the final prompt token
+        is always recomputed (its logits are needed, and its KV write must
+        land in a page this sequence owns — never a shared one).  The walk
+        chains each key on the MATCHED parent block's id, so every hop is an
+        exact-content match (see ``block_key``)."""
+        bs = self.block_size
+        blocks: List[int] = []
+        keys: List[object] = []
+        parent: Optional[int] = None
+        for i in range((len(tokens) - 1) // bs):
+            key = block_key(parent, tuple(tokens[i * bs:(i + 1) * bs]))
+            b = self.allocator.lookup(key)
+            if b is None:
+                break
+            self.allocator.ref(b)
+            blocks.append(b)
+            keys.append(key)
+            parent = b
+        return blocks, keys
 
     def admit(self, uid: int, prompt_tokens: List[int]) -> SequenceDescriptor:
         if uid in self.seqs:
@@ -92,6 +290,12 @@ class StateManager:
             raise RuntimeError("no free sequence slots")
         seq = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(0))
         seq.tokens = list(prompt_tokens)
+        if self.enable_prefix_caching:
+            seq.blocks, seq.hashes = self._match_prefix(seq.tokens)
+            seq.cached_tokens = len(seq.blocks) * self.block_size
+            seq.seen_tokens = seq.cached_tokens
+            self.cached_prompt_tokens += seq.cached_tokens
+        self.prompt_tokens_total += len(seq.tokens)
         self.seqs[uid] = seq
         return seq
 
@@ -99,6 +303,74 @@ class StateManager:
         n = self.blocks_needed(seq, new_tokens)
         if n:
             seq.blocks.extend(self.allocator.allocate(n))
+
+    def ensure_writable(self, seq: SequenceDescriptor, pos: int) -> None:
+        """Copy-on-write guard: the page holding token position ``pos`` must
+        be exclusively owned before it is written.  In the block-granular
+        sharing scheme only FULL blocks are ever shared, so writes normally
+        land in unshared pages — this is the safety net that keeps that an
+        invariant rather than an assumption."""
+        i = pos // self.block_size
+        if i >= len(seq.blocks):
+            return
+        b = seq.blocks[i]
+        if self.allocator.refcount(b) <= 1:
+            return
+        [new] = self.allocator.allocate(1)
+        if self.cow_hook is not None:
+            self.cow_hook(b, new)
+        self.allocator.free([b])
+        seq.blocks[i] = new
+        del seq.hashes[i:]  # content diverges from the published chain here
+        self.cow_copies += 1
+
+    def extend_match(self, seq: SequenceDescriptor) -> None:
+        """Late re-match: blocks published AFTER this sequence was admitted
+        (typically by the cold request ahead of it in the same arrival
+        burst) replace its corresponding still-unwritten fresh pages.  Only
+        runs while the hash chain is flush with prefill progress, so every
+        replaced page is provably unwritten; the recompute cap of
+        ``_match_prefix`` applies unchanged."""
+        if not self.enable_prefix_caching:
+            return
+        bs = self.block_size
+        cap = (len(seq.tokens) - 1) // bs
+        while seq.seen_tokens == len(seq.hashes) * bs:
+            i = len(seq.hashes)
+            if i >= cap or i >= len(seq.blocks):
+                break
+            parent = seq.blocks[i - 1] if i else None
+            key = block_key(parent, tuple(seq.tokens[i * bs:(i + 1) * bs]))
+            b = self.allocator.lookup(key)
+            if b is None:
+                break
+            old = seq.blocks[i]
+            self.allocator.ref(b)
+            seq.blocks[i] = b
+            self.allocator.free([old])
+            seq.hashes.append(key)
+            seq.seen_tokens = (i + 1) * bs
+            seq.cached_tokens = seq.seen_tokens
+            self.cached_prompt_tokens += bs
+
+    def update_hashes(self, seq: SequenceDescriptor) -> None:
+        """Publish every newly-FULL block of ``seq`` (prompt and generated
+        alike — generated pages make preemption-by-recompute cheap).  Only
+        tokens whose KV is actually written (``seen_tokens``) count."""
+        if not self.enable_prefix_caching:
+            return
+        bs = self.block_size
+        full = min(seq.seen_tokens, len(seq.blocks) * bs) // bs
+        while len(seq.hashes) < full:
+            i = len(seq.hashes)
+            parent = seq.blocks[i - 1] if i else None
+            key = block_key(parent, tuple(seq.tokens[i * bs:(i + 1) * bs]))
+            seq.hashes.append(key)
+            # register only canonical chains: if the parent block lost (or
+            # never won) its key, a child key naming it would dangle once
+            # the parent id is reused — unreachable at best, wrong at worst
+            if parent is None or self.allocator.key_of(parent) is not None:
+                self.allocator.register(seq.blocks[i], key, parent=parent)
 
     def release(self, uid: int) -> None:
         seq = self.seqs.pop(uid)

@@ -1,0 +1,368 @@
+"""Serving scheduler: continuous batching with queueing admission, chunked
+prefill, and preemption-by-recompute over the paged-KV engine.
+
+The FastGen serve-loop analogue (reference ``mii``/DeepSpeed-FastGen blog +
+``inference/v2/scheduling_utils.py``): ``submit()`` never throws on capacity
+— requests wait in a FIFO queue and each ``tick()`` runs
+
+    admission  ->  chunked prefill  ->  decode
+
+* **Admission** pops waiting requests in arrival order under a watermark:
+  a request is admitted only if its fresh (non-prefix-cached) prompt blocks
+  leave ``kv_watermark`` of the pool allocatable, so decode growth of the
+  running batch cannot deadlock against a full pool.  Younger requests may
+  be admitted past one that does not fit — until it has waited
+  ``starvation_ticks``, after which nothing jumps the queue (anti-starvation
+  aging).
+* **Chunked prefill** (Dynamic SplitFuse shape): each tick dispatches at
+  most ``prefill_chunk`` prompt tokens, page-aligned, so one long prompt
+  never stalls the decoding batch for its whole forward pass — and prompts
+  longer than the largest prefill bucket become servable at all (the
+  ``put()`` fast path rejects them).  Continuation chunks attend over the
+  already-written pages via the engine's context-aware packed prefill; a
+  prefix-cache hit is just a chunk whose context came from another request.
+* **Decode** runs one batched tick over the scheduler's running set only
+  (``put()``-admitted sequences are not side-driven).  When page growth
+  finds the pool truly exhausted, the youngest running request is preempted
+  by recompute: its pages are released (full pages stay in the prefix-cache
+  LRU), and it requeues at the FRONT with prompt = everything generated so
+  far — re-prefill is then mostly cache hits.
+
+TPU note: a tick is two static-shape dispatches (one prefill pack + one
+decode batch), not the reference's single mixed ragged batch — fusing both
+into one kernel launch is a Pallas-kernel-level follow-up.
+
+One restriction: all concurrently scheduled requests must share the device
+sampling triple (temperature/top_k/top_p) — it is a static jit argument and
+the batch shares one dispatch.  Per-request ``stop_token`` and
+``max_new_tokens`` are host-side and unrestricted.  The triple resets when
+the scheduler drains idle.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .sampling import SamplingParams
+
+WAITING, PREFILL, DECODE, FINISHED = "waiting", "prefill", "decode", "finished"
+
+
+@dataclass
+class ServeRequest:
+    """Host-side lifecycle of one submitted generation request."""
+
+    uid: int
+    prompt: List[int]  # original prompt (output accounting)
+    sampling: SamplingParams
+    tokens: List[int]  # prefilled on (re)admission: prompt + generated so far
+    state: str = WAITING
+    generated: List[int] = field(default_factory=list)
+    submit_tick: int = 0
+    admit_tick: int = -1  # first admission
+    preemptions: int = 0
+    denied_state: Optional[tuple] = None  # admission state at last failed probe
+
+
+class ServeScheduler:
+    def __init__(
+        self,
+        engine,
+        prefill_chunk: Optional[int] = None,
+        kv_watermark: float = 0.0625,
+        starvation_ticks: int = 32,
+    ):
+        self.engine = engine
+        bs = engine.block_size
+        chunk = min(prefill_chunk or engine.prefill_budget, engine.prefill_budget)
+        self.prefill_chunk = max(bs, (chunk // bs) * bs)
+        total = engine.mgr.allocator.total_blocks
+        self._watermark_blocks = max(1, round(total * kv_watermark))
+        self.starvation_ticks = starvation_ticks
+        self.waiting: "deque[ServeRequest]" = deque()
+        self.requests: Dict[int, ServeRequest] = {}
+        self._running: List[ServeRequest] = []  # admission order
+        self.tick_no = 0
+        self._triple = None  # shared device sampling triple
+        self._uid_counter = 0
+        self.stats = {
+            "submitted": 0, "finished": 0, "admissions": 0,
+            "preemptions": 0, "queue_wait_ticks": 0, "prefill_chunks": 0,
+        }
+
+    # -- request intake -----------------------------------------------------
+    def next_uid(self) -> int:
+        while True:
+            self._uid_counter += 1
+            uid = self._uid_counter
+            if uid not in self.requests and uid not in self.engine.mgr.seqs:
+                return uid
+
+    def submit(
+        self, uid: int, tokens: Sequence[int],
+        sampling: SamplingParams = SamplingParams(),
+    ) -> None:
+        """Queue a request.  Never raises on CAPACITY — only on requests
+        that are invalid outright (duplicate uid, empty prompt, a prompt the
+        engine could never hold even with the whole pool to itself, or a
+        sampling triple conflicting with the currently scheduled batch)."""
+        tokens = [int(t) for t in tokens]
+        if uid in self.requests or uid in self.engine.mgr.seqs:
+            # the mgr check covers put()-admitted sequences: deferring the
+            # collision to admission would blow up mid-tick instead
+            raise ValueError(f"uid {uid} already in use")
+        if not tokens:
+            raise ValueError("empty prompt")
+        eng = self.engine
+        if len(tokens) >= eng.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(tokens)} leaves no room to generate "
+                f"(max_seq_len {eng.max_seq_len})"
+            )
+        # the request must fit the pool ALONE at its maximum length — prompt
+        # plus full generation budget — or decode growth eventually exhausts
+        # the pool with no victim left to preempt and the whole loop dies.
+        # A stop token may end generation earlier, but admission cannot bet
+        # on that; size the pool (or max_new_tokens) for the worst case.
+        max_len = min(len(tokens) + sampling.max_new_tokens, eng.max_seq_len)
+        blocks = -(-max_len // eng.block_size)
+        if blocks > eng.mgr.allocator.total_blocks:
+            raise ValueError(
+                f"prompt + max_new_tokens needs {blocks} KV blocks; the "
+                f"pool only has {eng.mgr.allocator.total_blocks}"
+            )
+        triple = (sampling.temperature, sampling.top_k, sampling.top_p)
+        if not self._running and not self.waiting:
+            self._triple = triple
+        elif triple != self._triple:
+            raise ValueError(
+                f"sampling triple {triple} conflicts with the scheduled "
+                f"batch's {self._triple} (one static triple per dispatch)"
+            )
+        req = ServeRequest(uid=uid, prompt=tokens, sampling=sampling,
+                           tokens=list(tokens), submit_tick=self.tick_no)
+        self.requests[uid] = req
+        self.waiting.append(req)
+        self.stats["submitted"] += 1
+
+    def _base_sampling(self) -> SamplingParams:
+        t, k, p = self._triple
+        return SamplingParams(temperature=t, top_k=k, top_p=p)
+
+    # -- admission ----------------------------------------------------------
+    def _try_admit(self, req: ServeRequest) -> bool:
+        mgr = self.engine.mgr
+        if not mgr.free_slots:
+            return False
+        total_blocks = -(-len(req.tokens) // mgr.block_size)
+        # tentative admit performs the prefix match (refs cached blocks);
+        # roll it — and its hit-rate counters — back if the fresh remainder
+        # does not fit under the watermark
+        pt, ct = mgr.prompt_tokens_total, mgr.cached_prompt_tokens
+        seq = mgr.admit(req.uid, req.tokens)
+        fresh = total_blocks - len(seq.blocks)
+        # the watermark reserves decode-growth headroom, but only while a
+        # running batch exists to grow — an idle pool admits to the brim
+        headroom = self._watermark_blocks if self._running else 0
+        if fresh + headroom > mgr.allocator.available_blocks:
+            mgr.release(req.uid)
+            mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
+            return False
+        mgr.ensure_capacity(seq, 0)  # reserve every prompt page up front
+        req.state = PREFILL
+        if req.admit_tick < 0:
+            req.admit_tick = self.tick_no
+            self.stats["queue_wait_ticks"] += self.tick_no - req.submit_tick
+        self._running.append(req)
+        self.stats["admissions"] += 1
+        return True
+
+    def _admit_phase(self) -> None:
+        mgr = self.engine.mgr
+        for req in list(self.waiting):
+            if not mgr.free_slots:
+                break
+            # admission outcome depends only on free slots, allocatable
+            # blocks, and cache contents (every content change bumps
+            # `registrations` or moves `available_blocks`): skip the full
+            # tentative-admit probe — an O(prompt) prefix walk — when none
+            # of that moved since this request was last denied
+            state = (mgr.free_slots, mgr.allocator.available_blocks,
+                     mgr.allocator.registrations)
+            denied = req.denied_state == state or not self._try_admit(req)
+            if not denied:
+                self.waiting.remove(req)
+            else:
+                req.denied_state = state
+                if self.tick_no - req.submit_tick >= self.starvation_ticks:
+                    break  # aged request: nothing may jump the queue past it
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_phase(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        bs = self.engine.block_size
+        mgr = self.engine.mgr
+        budget = self.prefill_chunk
+        entries = []
+        for req in self._running:
+            if req.state != PREFILL or budget < bs:
+                continue
+            seq = mgr.seqs[req.uid]
+            # pick up prefix blocks published since admission (a request
+            # queued behind the cold request that is WRITING its prefix
+            # would otherwise recompute it)
+            mgr.extend_match(seq)
+            start = seq.seen_tokens
+            remaining = len(seq.tokens) - start
+            take = min(remaining, budget)
+            if take < remaining:
+                take -= take % bs  # chunk boundaries stay page-aligned
+                if take == 0:
+                    continue
+            entries.append((seq, start, start + take))
+            budget -= take
+        if not entries:
+            return out
+        first = self.engine.prefill_entries(entries, self._base_sampling())
+        self.stats["prefill_chunks"] += len(entries)
+        for req in list(self._running):
+            if req.state == PREFILL and req.uid in first:
+                tok = first[req.uid]
+                req.state = DECODE
+                req.generated.append(tok)
+                out[req.uid] = tok
+                self._maybe_finish(req)
+        return out
+
+    # -- decode + preemption ------------------------------------------------
+    def _pick_victim(self, exclude: ServeRequest) -> Optional[ServeRequest]:
+        for req in reversed(self._running):  # youngest admission first
+            if req is not exclude and req.state in (PREFILL, DECODE):
+                return req
+        return None
+
+    def _preempt(self, req: ServeRequest) -> None:
+        """Preemption by recompute: drop the sequence's pages (full ones
+        stay in the prefix-cache LRU) and requeue at the FRONT with prompt =
+        all tokens so far — re-prefill is then mostly cache hits."""
+        seq = self.engine.mgr.seqs[req.uid]
+        req.tokens = list(seq.tokens)
+        self.engine.mgr.release(req.uid)
+        self._running.remove(req)
+        req.state = WAITING
+        req.preemptions += 1
+        self.waiting.appendleft(req)
+        self.stats["preemptions"] += 1
+
+    def _decode_phase(self, decoding: List[ServeRequest]) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        mgr = self.engine.mgr
+        for req in decoding:
+            if req.state != DECODE:  # preempted by an earlier victim pick
+                continue
+            seq = mgr.seqs[req.uid]
+            while True:
+                try:
+                    mgr.ensure_capacity(seq, 1)
+                    mgr.ensure_writable(seq, seq.cur_len - 1)
+                    break
+                except RuntimeError:
+                    victim = self._pick_victim(exclude=req)
+                    if victim is None:
+                        raise RuntimeError(
+                            "KV pool cannot hold even one growing sequence "
+                            f"({mgr.allocator.total_blocks} blocks)"
+                        ) from None
+                    self._preempt(victim)
+        survivors = [r for r in decoding if r.state == DECODE]
+        if not survivors:
+            return out
+        toks = self.engine._decode_tick(
+            [mgr.seqs[r.uid] for r in survivors], self._base_sampling()
+        )
+        for req in survivors:
+            tok = toks[req.uid]
+            req.generated.append(tok)
+            out[req.uid] = tok
+            self._maybe_finish(req)
+        return out
+
+    # -- completion ---------------------------------------------------------
+    def _maybe_finish(self, req: ServeRequest) -> None:
+        samp = req.sampling
+        done = (
+            (samp.stop_token is not None
+             and req.generated[-1] == samp.stop_token)
+            or len(req.generated) >= samp.max_new_tokens
+            or self.engine.mgr.seqs[req.uid].cur_len >= self.engine.max_seq_len
+        )
+        if done:
+            self.engine.mgr.release(req.uid)
+            self._running.remove(req)
+            req.state = FINISHED
+            self.stats["finished"] += 1
+
+    def result(self, uid: int) -> List[int]:
+        """Generated tokens with ``generate()`` semantics: trailing stop
+        token stripped, capped at ``max_new_tokens``.  Finished requests
+        stay in ``self.requests`` (pinning their token history) until
+        ``pop_result`` — long-lived serve loops must pop, or host memory
+        grows with every request ever served."""
+        req = self.requests[uid]
+        toks = list(req.generated)
+        samp = req.sampling
+        if samp.stop_token is not None and toks and toks[-1] == samp.stop_token:
+            toks = toks[:-1]
+        return toks[: samp.max_new_tokens]
+
+    def pop_result(self, uid: int) -> List[int]:
+        toks = self.result(uid)
+        del self.requests[uid]
+        return toks
+
+    # -- the loop -----------------------------------------------------------
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and not self._running
+
+    def tick(self) -> Dict[int, int]:
+        """One scheduler tick: admission -> chunked prefill -> decode.
+        Returns the newest token per request that emitted one (a request
+        finishing its prefill emits its first token; it joins the decode
+        batch from the NEXT tick)."""
+        self.tick_no += 1
+        self._admit_phase()
+        decoding = [r for r in self._running if r.state == DECODE]
+        out = self._prefill_phase()
+        out.update(self._decode_phase(decoding))
+        return out
+
+    def run(self, wait_for: Optional[Sequence[int]] = None,
+            max_ticks: int = 1_000_000) -> Dict[int, List[int]]:
+        """Tick until every request (or every uid in ``wait_for``) finishes;
+        returns {uid: result}."""
+        def pending() -> bool:
+            if wait_for is not None:
+                return any(self.requests[u].state != FINISHED for u in wait_for)
+            return not self.idle
+
+        ticks = stalled = 0
+        while pending():
+            if ticks >= max_ticks:
+                raise RuntimeError(f"no convergence after {max_ticks} ticks")
+            self.tick()
+            ticks += 1
+            # nothing running and nothing admittable: the pool/slots are
+            # held outside the scheduler (put()-admitted sequences) and no
+            # tick can ever make progress — fail loudly instead of spinning
+            stalled = stalled + 1 if (not self._running and self.waiting) else 0
+            if stalled > 1000:
+                raise RuntimeError(
+                    "scheduler stalled: waiting requests cannot be admitted "
+                    "(KV blocks/slots held by sequences outside the scheduler)"
+                )
+        uids = wait_for if wait_for is not None else [
+            u for u, r in self.requests.items() if r.state == FINISHED
+        ]
+        return {u: self.result(u) for u in uids}

@@ -122,9 +122,9 @@ def test_packed_prefill_one_dispatch_for_many_prompts():
     calls = []
     orig = engine._run_packed_prefill
 
-    def counting(seqs, sampling, out):
-        calls.append(len(seqs))
-        return orig(seqs, sampling, out)
+    def counting(entries, sampling, out):
+        calls.append(len(entries))
+        return orig(entries, sampling, out)
 
     engine._run_packed_prefill = counting
     rng = np.random.default_rng(2)
@@ -140,9 +140,9 @@ def test_packed_prefill_splits_at_budget():
     calls = []
     orig = engine._run_packed_prefill
 
-    def counting(seqs, sampling, out):
-        calls.append(sum(len(s.tokens) for s in seqs))
-        return orig(seqs, sampling, out)
+    def counting(entries, sampling, out):
+        calls.append(sum(end - start for _, start, end in entries))
+        return orig(entries, sampling, out)
 
     engine._run_packed_prefill = counting
     rng = np.random.default_rng(3)
