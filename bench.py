@@ -380,21 +380,139 @@ def offload_main():
     }))
 
 
-def serve8b_main():
-    """Llama-3-8B int8 serving on ONE 16GB v5e (`python bench.py --serve8b`):
-    the capacity proof — bf16 weights alone are 15 GiB (HBM is 16), int8 +
-    per-output-channel scales are ~8 GiB and serve with the paged KV pool.
-    Weights are random (throughput/capacity proof, not a quality claim),
-    built LEAF-BY-LEAF on device so peak memory never exceeds one bf16 leaf
-    plus the growing int8 tree.  Reference story: ZeRO-Inference /
-    FP6-on-one-GPU (blogs/deepspeed-fp6: LLaMA-70B on one A100-80G)."""
+def _time_jit(fn, *args, reps: int = 3, inner: int = 1) -> float:
+    """Best-of-``reps`` wall time of a jitted call (compile + warmup first)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def quant_kernels_main():
+    """Kernel-level microbench (`python bench.py --quant-kernels`): the
+    fused Pallas dequant-matmul (ops/pallas/quant_matmul.py) vs the
+    dequantize-then-matmul ``x @ q.astype`` path it replaces, at the 410M
+    and 8B decode matmul shapes, for int8 and FP6 (bf16 dense as anchor).
+    The number that matters is effective weight bandwidth: decode matmuls
+    are weight-bound, so fused int8 should approach 2x bf16 and FP6 ~2.7x
+    — the inversion VERDICT r5 weak #2 called out closes when
+    fp6_fused <= bf16.  Off-TPU this smoke-runs a tiny shape through the
+    kernel interpreter (timings there measure the interpreter, not the
+    chip — shape/dispatch coverage only)."""
+    import functools
+
+    from deepspeed_tpu.ops import quantizer as Q
+    from deepspeed_tpu.ops.pallas import quant_matmul as qm
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        m = 32  # decode batch
+        shape_sets = {
+            "410m": [(1024, 1024), (1024, 4096), (4096, 1024), (1024, 32128)],
+            "8b": [(4096, 4096), (4096, 14336), (14336, 4096), (4096, 128256)],
+        }
+    else:
+        qm.set_interpret(True)
+        m = 8
+        shape_sets = {"smoke": [(512, 256)]}
+
+    dense_mm = jax.jit(lambda x, w: x @ w)
+    cur_int8 = jax.jit(
+        lambda x, q, s: ((x @ q.astype(x.dtype)) * s).astype(x.dtype)
+    )
+    fused_int8 = jax.jit(qm.quant_matmul)
+
+    def cur_fp6(x, packed, s, in_dim):
+        deq = Q._fp6_decode(Q._fp6_unpack(packed, in_dim), x.dtype)
+        return ((x @ deq) * s).astype(x.dtype)
+
+    rows = []
+    key = jax.random.PRNGKey(0)
+    for name, shapes in shape_sets.items():
+        for k, n in shapes:
+            key, k1, k2 = jax.random.split(key, 3)
+            x = jax.random.normal(k1, (m, k), jnp.bfloat16)
+            w = jax.random.normal(k2, (k, n), jnp.float32) * 0.02
+            qi = Q.quantize_serving_weight(w, "int8")
+            q6 = Q.quantize_serving_weight_fp6(w)
+            wb = w.astype(jnp.bfloat16)
+            t_bf16 = _time_jit(dense_mm, x, wb)
+            t_cur8 = _time_jit(cur_int8, x, qi.q, qi.s)
+            t_fus8 = _time_jit(fused_int8, x, qi.q, qi.s)
+            t_cur6 = _time_jit(
+                jax.jit(functools.partial(cur_fp6, in_dim=k)), x, q6.packed, q6.s
+            )
+            t_fus6 = _time_jit(
+                jax.jit(functools.partial(qm.quant_matmul_fp6, in_dim=k)),
+                x, q6.packed, q6.s,
+            )
+            rows.append({
+                "model": name, "shape": [k, n],
+                "bf16_us": round(1e6 * t_bf16, 1),
+                "int8_current_us": round(1e6 * t_cur8, 1),
+                "int8_fused_us": round(1e6 * t_fus8, 1),
+                "fp6_current_us": round(1e6 * t_cur6, 1),
+                "fp6_fused_us": round(1e6 * t_fus6, 1),
+                "int8_fused_vs_current": round(t_cur8 / t_fus8, 2),
+                "fp6_fused_vs_current": round(t_cur6 / t_fus6, 2),
+                "fp6_fused_vs_bf16": round(t_bf16 / t_fus6, 2),
+                "int8_fused_gb_s": round(k * n / t_fus8 / 1e9, 1),
+                "fp6_fused_gb_s": round(0.75 * k * n / t_fus6 / 1e9, 1),
+                "bf16_gb_s": round(2 * k * n / t_bf16 / 1e9, 1),
+            })
+    if not on_tpu:
+        qm.set_interpret(False)
+    agg = lambda f: round(float(np.mean([r[f] for r in rows])), 2)
+    print(json.dumps({
+        "metric": "quant_matmul_fused_vs_current_speedup_mean",
+        "value": agg("int8_fused_vs_current"),
+        "unit": "x",
+        "vs_baseline": None,
+        "extra": {
+            "decode_batch": m,
+            "interpret_smoke": not on_tpu,
+            "fp6_fused_vs_current_mean": agg("fp6_fused_vs_current"),
+            "fp6_fused_vs_bf16_mean": agg("fp6_fused_vs_bf16"),
+            "rows": rows,
+        },
+    }))
+
+
+def serve8b_main(quant: str = "int8"):
+    """Llama-3-8B quantized serving on ONE 16GB v5e
+    (`python bench.py --serve8b [--quant int8|fp8|fp6]`): the capacity
+    proof — bf16 weights alone are 15 GiB (HBM is 16), int8 + per-output-
+    channel scales are ~8 GiB (FP6 ~6.2 GiB) and serve with the paged KV
+    pool.  Weights are random (throughput/capacity proof, not a quality
+    claim), built LEAF-BY-LEAF on device so peak memory never exceeds one
+    bf16 leaf plus the growing compressed tree.  Reference story:
+    ZeRO-Inference / FP6-on-one-GPU (blogs/deepspeed-fp6: LLaMA-70B on one
+    A100-80G).
+
+    Beyond the headline decode number this prints the 8B roofline evidence
+    VERDICT r5 weak #3 asked for: a per-tick breakdown (weight-stream
+    kernel / scale epilogue / paged attention / sampling / dispatch) from
+    standalone timings of each stage at the served shapes, a batch 4->32
+    scaling study, and the effective weight bandwidth per tick."""
+    import functools
+
     from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
-    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.inference.paged import paged_attention_decode
+    from deepspeed_tpu.inference.sampling import SamplingParams, sample
     from deepspeed_tpu.models import get_preset
     from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.ops import quantizer as Q
     from deepspeed_tpu.ops.quantizer import (
         _SERVING_QUANT_PATHS,
         quantize_serving_weight,
+        quantize_serving_weight_fp6,
+        serving_mm,
         tree_nbytes,
     )
     from deepspeed_tpu.runtime.zero import path_str
@@ -414,7 +532,11 @@ def serve8b_main():
             x = (jax.random.normal(k, sds.shape, jnp.float32) * 0.02).astype(
                 jnp.bfloat16
             )
-            return quantize_serving_weight(x, "int8") if quantize else x
+            if not quantize:
+                return x
+            if quant == "fp6":
+                return quantize_serving_weight_fp6(x)
+            return quantize_serving_weight(x, quant)
 
         return jax.jit(gen)(key)
 
@@ -427,31 +549,160 @@ def serve8b_main():
         leaves.append(build_leaf(sub, sds, q))
     params = jax.tree_util.tree_unflatten(treedef, leaves)
     resident_gib = tree_nbytes(params) / 2**30
+    layer_w = dict(params["layers"]["attn"], mlp=params["layers"]["mlp"])
 
-    B, blocks, prompt_len, steps = (4, 192, 128, 32) if on_tpu else (2, 32, 16, 4)
-    eng = InferenceEngineV2(
-        params, cfg, max_seqs=B, num_blocks=blocks, block_size=32 if on_tpu else 8,
-        prefill_buckets=(128, 256, 512) if on_tpu else (16,),
-        prefill_budget=512 if on_tpu else 16,
-    )
-    samp = SamplingParams(temperature=0.0, max_new_tokens=steps + 8)
+    if on_tpu:
+        batches, prompt_len, steps = (4, 8, 16, 32), 128, 32
+        blocks_for = lambda B: max(192, 6 * B + 32)
+        block_size, buckets, budget = 32, (128, 256, 512), 512
+    else:
+        batches, prompt_len, steps = (2, 4), 16, 4
+        blocks_for = lambda B: 48
+        block_size, buckets, budget = 8, (16,), 16
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(B)]
-    eng.put(list(range(1, B + 1)), prompts, samp)
-    eng.step_n(4, samp)  # warm decode
-    t0 = time.perf_counter()
-    eng.step_n(steps, samp)
-    dt = time.perf_counter() - t0
+    samp = SamplingParams(temperature=0.0, max_new_tokens=steps + 8)
+
+    scaling = []
+    tick_headline = None
+    headline_eng = None
+    for B in batches:
+        eng = InferenceEngineV2(
+            params, cfg, max_seqs=B, num_blocks=blocks_for(B),
+            block_size=block_size, prefill_buckets=buckets,
+            prefill_budget=budget,
+        )
+        prompts = [
+            rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+            for _ in range(B)
+        ]
+        eng.put(list(range(1, B + 1)), prompts, samp)
+        eng.step_n(4, samp)  # warm decode
+        t0 = time.perf_counter()
+        eng.step_n(steps, samp)
+        dt = time.perf_counter() - t0
+        if B == batches[0]:
+            tick_headline = dt / steps
+            headline_eng = eng
+        scaling.append({
+            "batch": B,
+            "ms_per_tick": round(1e3 * dt / steps, 2),
+            "decode_tok_s": round(B * steps / dt, 1),
+            # weight bytes the tick must stream / tick time: the roofline
+            # coordinate (v5e HBM ~819 GB/s)
+            "effective_weight_gb_s": round(
+                resident_gib * 2**30 / (dt / steps) / 1e9, 1
+            ),
+        })
+
+    # --- per-tick breakdown: standalone timings of each stage ------------
+    d, hq, hd, L = cfg.hidden_size, cfg.num_heads, cfg.hd, cfg.num_layers
+    B0 = batches[0]
+    key, kx = jax.random.split(key)
+    x0 = jax.random.normal(kx, (B0, d), jnp.bfloat16)
+
+    def weight_stream(params, x, mode="served"):
+        """Every serving matmul of one decode tick (L layers + head) at the
+        served [B, d] activation shapes — the weight-bandwidth stage.
+        ``mode``: 'served' = the path serving_mm actually takes (fused
+        kernel on TPU); 'jnp' = the unfused dequantize-then-matmul body;
+        'jnp_noscale' = that body without the per-channel scale multiply.
+        jnp vs jnp_noscale isolates the scale-epilogue cost the UNFUSED
+        path pays (the cost fusion folds away) on an apples-to-apples body."""
+        def mm(v, w):
+            if mode == "served":
+                return serving_mm(v, w)
+            scaled = mode == "jnp"
+            if isinstance(w, Q.ServingQuant):
+                y = v @ w.q.astype(v.dtype)
+                return (y * w.s).astype(v.dtype) if scaled else y
+            if isinstance(w, Q.ServingQuantFP6):
+                codes = Q._fp6_unpack(w.packed, w.in_dim)
+                y = v @ Q._fp6_decode(codes, v.dtype)
+                return (y * w.s).astype(v.dtype) if scaled else y
+            return v @ w
+
+        acc = jnp.zeros_like(x)
+        for l in range(L):
+            lw = jax.tree_util.tree_map(lambda a: a[l], layer_w)
+            qh = mm(x, lw["wq"])
+            # k/v projections feed acc so DCE cannot drop their weight
+            # streams from the timed program (their [B, hkv*hd] outputs
+            # reduce to one scalar each — negligible extra work)
+            kh = mm(x, lw["wk"])
+            vh = mm(x, lw["wv"])
+            o = mm(qh, lw["wo"])
+            up = mm(x, lw["mlp"]["w_up"])
+            gate = mm(x, lw["mlp"]["w_gate"])
+            down = mm(jax.nn.silu(gate) * up, lw["mlp"]["w_down"])
+            acc = acc + o + down + kh.sum() + vh.sum()
+        head = mm(acc, params["lm_head"]["kernel"])
+        return acc, head.sum()
+
+    t_weights = _time_jit(
+        jax.jit(functools.partial(weight_stream, mode="served")), params, x0,
+    )
+    t_jnp = _time_jit(
+        jax.jit(functools.partial(weight_stream, mode="jnp")), params, x0,
+    )
+    t_jnp_noscale = _time_jit(
+        jax.jit(functools.partial(weight_stream, mode="jnp_noscale")),
+        params, x0,
+    )
+
+    # paged attention at the served shapes, over the engine's real pool
+    tables = headline_eng._tables_device()
+    lens = jnp.full((B0,), prompt_len + steps, jnp.int32)
+    key, kq = jax.random.split(key)
+    qd = jax.random.normal(kq, (B0, hq, hd), jnp.bfloat16)
+
+    def attn_tick(q, kv, tables, lens):
+        out = jnp.zeros_like(q)
+        for l in range(L):
+            out = out + paged_attention_decode(
+                q, kv[0][l], kv[1][l], tables, lens,
+                logits_soft_cap=cfg.logits_soft_cap,
+            )
+        return out
+
+    t_attn = _time_jit(jax.jit(attn_tick), qd, headline_eng.kv, tables, lens)
+
+    key, kl = jax.random.split(key)
+    logits0 = jax.random.normal(kl, (B0, cfg.vocab_size), jnp.float32)
+    t_sample = _time_jit(
+        jax.jit(lambda lg, r: sample(lg, samp, r)), logits0, key
+    )
+    accounted = t_weights + t_attn + t_sample
+    breakdown = {
+        "weight_stream_ms": round(1e3 * t_weights, 2),
+        "weight_stream_unfused_ms": round(1e3 * t_jnp, 2),
+        # scale cost of the UNFUSED body (what fusion folds into the
+        # epilogue); measured jnp-vs-jnp so kernel speedup can't mask it
+        "scale_epilogue_unfused_ms": round(
+            1e3 * max(t_jnp - t_jnp_noscale, 0.0), 2
+        ),
+        "paged_attention_ms": round(1e3 * t_attn, 2),
+        "sampling_ms": round(1e3 * t_sample, 2),
+        "dispatch_other_ms": round(1e3 * max(tick_headline - accounted, 0.0), 2),
+        "tick_ms": round(1e3 * tick_headline, 2),
+    }
+
     print(json.dumps({
-        "metric": f"serve_decode_tokens_per_sec_{preset}_int8_single_chip",
-        "value": round(B * steps / dt, 1),
+        "metric": f"serve_decode_tokens_per_sec_{preset}_{quant}_single_chip",
+        "value": scaling[0]["decode_tok_s"],
         "unit": "tokens/s",
         "vs_baseline": None,
         "extra": {
-            "params_b": round(sum(int(np.prod(l.shape)) for _, l in flat) / 1e9, 2),
+            "params_b": round(
+                sum(int(np.prod(l.shape)) for _, l in flat) / 1e9, 2
+            ),
             "weights_resident_gib": round(resident_gib, 2),
-            "batch": B, "ms_per_tick": round(1e3 * dt / steps, 1),
-            "tok_per_sec_per_seq": round(steps / dt, 1),
+            "quantize_weights": quant,
+            "batch": B0,
+            "ms_per_tick": scaling[0]["ms_per_tick"],
+            "tok_per_sec_per_seq": round(scaling[0]["decode_tok_s"] / B0, 1),
+            "effective_weight_gb_s": scaling[0]["effective_weight_gb_s"],
+            "tick_breakdown": breakdown,
+            "batch_scaling": scaling,
             "note": "random weights: capacity/throughput proof (bf16 weights "
                     "alone would exceed the 16GB HBM)",
         },
@@ -540,16 +791,18 @@ def longctx_main():
 if __name__ == "__main__":
     import sys
 
+    q = None
+    if "--quant" in sys.argv:
+        q = sys.argv[sys.argv.index("--quant") + 1]
     if "--serving" in sys.argv:
-        q = None
-        if "--quant" in sys.argv:
-            q = sys.argv[sys.argv.index("--quant") + 1]
         serving_main(quant=q)
     elif "--offload" in sys.argv:
         offload_main()
     elif "--longctx" in sys.argv:
         longctx_main()
     elif "--serve8b" in sys.argv:
-        serve8b_main()
+        serve8b_main(quant=q or "int8")
+    elif "--quant-kernels" in sys.argv:
+        quant_kernels_main()
     else:
         main()

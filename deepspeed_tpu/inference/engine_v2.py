@@ -125,8 +125,17 @@ class InferenceEngineV2:
             import jax.tree_util as jtu
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from ..ops.quantizer import set_fused_serving
             from ..parallel.auto_tp import infer_tp_rules
             from ..runtime.zero import match_rules, path_str
+
+            # fused dequant-matmul has no GSPMD sharding rule: under TP the
+            # partitioner would gather the full weight per shard.  The jnp
+            # serving_mm body partitions cleanly, so TP serving pins it.
+            # (Process-wide switch: engines trace at first dispatch, so a TP
+            # engine in the process keeps later engines on the jnp body too
+            # — correct everywhere, fused perf only matters single-chip.)
+            set_fused_serving(False)
 
             self._mesh = grid.mesh
             rules = infer_tp_rules(params, tp, vocab_size=cfg.vocab_size)

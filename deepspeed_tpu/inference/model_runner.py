@@ -40,12 +40,13 @@ Params = Any
 def _qkv(lw, x, cfg: TransformerConfig):
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
-    # serving_mm: transparent over quantized-weight serving (ServingQuant)
-    q = serving_mm(x, lw["wq"])
-    k = serving_mm(x, lw["wk"])
-    v = serving_mm(x, lw["wv"])
-    if cfg.qkv_bias:
-        q, k, v = q + lw["bq"], k + lw["bk"], v + lw["bv"]
+    # serving_mm: transparent over quantized-weight serving (ServingQuant);
+    # biases ride the call so the fused dequant-matmul kernel folds them
+    # into its fp32 epilogue (on the jnp body they add post-cast, exactly
+    # as before)
+    q = serving_mm(x, lw["wq"], lw.get("bq") if cfg.qkv_bias else None)
+    k = serving_mm(x, lw["wk"], lw.get("bk") if cfg.qkv_bias else None)
+    v = serving_mm(x, lw["wv"], lw.get("bv") if cfg.qkv_bias else None)
     return (
         q.reshape(b, s, hq, hd),
         k.reshape(b, s, hkv, hd),
@@ -63,36 +64,24 @@ def _ffn(lw, x, cfg):
         return out
     mlp = lw["mlp"]
     act = _activation(cfg.activation)
-    up = serving_mm(x, mlp["w_up"])
-    if "b_up" in mlp:  # gpt2/opt/phi-style biased MLP
-        up = up + mlp["b_up"]
+    # gpt2/opt/phi-style biased MLP: biases fuse into the serving matmul
+    up = serving_mm(x, mlp["w_up"], mlp.get("b_up"))
     if cfg.gated_mlp:
-        gate = serving_mm(x, mlp["w_gate"])
-        if "b_gate" in mlp:
-            gate = gate + mlp["b_gate"]
+        gate = serving_mm(x, mlp["w_gate"], mlp.get("b_gate"))
         h = act(gate) * up
     else:
         h = act(up)
-    out = serving_mm(h, mlp["w_down"])
-    if "b_down" in mlp:
-        out = out + mlp["b_down"]
-    return out
+    return serving_mm(h, mlp["w_down"], mlp.get("b_down"))
 
 
 def _attn_out(lw, x):
     """o-projection (+ bias when the family carries one)."""
-    out = serving_mm(x, lw["wo"])
-    if "bo" in lw:
-        out = out + lw["bo"]
-    return out
+    return serving_mm(x, lw["wo"], lw.get("bo"))
 
 
 def _lm_logits(params, cfg, x):
     """Final head (+ gptj/phi lm_head bias) in fp32."""
-    logits = serving_mm(x, head_kernel(params, cfg))
-    bias = head_bias_vec(params)
-    if bias is not None:
-        logits = logits + bias
+    logits = serving_mm(x, head_kernel(params, cfg), head_bias_vec(params))
     return logits.astype(jnp.float32)
 
 

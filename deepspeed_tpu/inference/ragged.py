@@ -144,9 +144,15 @@ class BlockedAllocator:
     def free(self, blocks: List[int]) -> None:
         """Drop one reference per block; last reference retires the block to
         the cached LRU (keyed) or the free list (unkeyed)."""
-        for b in blocks:
+        from collections import Counter
+
+        counts = Counter(blocks)
+        for b, n in counts.items():
             self._check(b)
-            if self._refs[b] <= 0:
+            # count duplicates within THIS call too: validating all entries
+            # before any decrement would let free([b, b]) at refcount 1
+            # slip past and drive the refcount negative
+            if self._refs[b] < n:
                 raise ValueError(f"double free of block {b}")
         for b in blocks:
             self._refs[b] -= 1

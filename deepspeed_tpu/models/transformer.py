@@ -251,10 +251,24 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
 def alibi_bias(
     num_heads: int, q_positions: jnp.ndarray, kv_positions: jnp.ndarray
 ) -> jnp.ndarray:
-    """[h, sq, skv] additive attention bias: -slope_h * (q_pos - k_pos)
-    for keys at or before the query (the causal mask handles the rest)."""
-    dist = q_positions[:, None].astype(jnp.float32) - kv_positions[None, :]
-    return -alibi_slopes(num_heads)[:, None, None] * jnp.maximum(dist, 0.0)
+    """Additive attention bias ``-slope_h * (q_pos - k_pos)`` for keys at
+    or before the query (the causal mask handles the rest).
+
+    ``q_positions``/``kv_positions`` may be [s] (shared row ->
+    [h, sq, skv]) or PER BATCH ROW [b, s] (-> [b, h, sq, skv]): distances
+    come from each row's ACTUAL positions — computing them from row 0's
+    positions and the raw key index silently skewed every other row
+    whenever rows disagree (left-padded batches, ragged decode offsets;
+    ADVICE r5 low #3)."""
+    batched = q_positions.ndim == 2 or kv_positions.ndim == 2
+    q2 = q_positions if q_positions.ndim == 2 else q_positions[None]
+    k2 = kv_positions if kv_positions.ndim == 2 else kv_positions[None]
+    dist = q2[:, :, None].astype(jnp.float32) - k2[:, None, :]  # [b, sq, skv]
+    bias = (
+        -alibi_slopes(num_heads)[None, :, None, None]
+        * jnp.maximum(dist, 0.0)[:, None]
+    )
+    return bias if batched else bias[0]
 
 
 def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
@@ -343,11 +357,29 @@ def attention_block(
         q_offset = cache_index
     kw = {}
     if cfg.position == "alibi":
-        # [h, sq, skv] additive bias from absolute positions (bloom); the
-        # reference attention impl is the alibi-capable body (_get_attn_fn
-        # enforces this)
-        qpos = positions[0] if positions.ndim == 2 else positions
-        kw["bias"] = alibi_bias(hq, qpos, jnp.arange(k.shape[1]))
+        # additive bias from the ACTUAL positions tensor, per batch row
+        # (bloom; ADVICE r5 low #3 — this used positions[0] + the raw key
+        # index for the whole batch).  Self-attention keys are the row's
+        # own tokens, so their positions ARE the row's positions.  Cached
+        # decode keys use the cache index as their position: the cache
+        # stores no per-slot positions, so this is exact only when cache
+        # writes are position-aligned — true for every engine flow (the v1
+        # cache writes row i's token at index cache_index + i with
+        # positions derived from the same arange); callers feeding a cache
+        # together with CUSTOM non-arange positions (e.g. left-padded rows)
+        # are outside this contract.  Packed segments raise: their
+        # positions restart mid-row while the cache index keeps counting,
+        # so no consistent key-position vector exists.  The reference
+        # attention impl is the alibi-capable body (_get_attn_fn enforces
+        # this).
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "position='alibi' does not support packed sequences "
+                "(segment_ids): per-segment restarting positions have no "
+                "consistent key-position vector against the cache index"
+            )
+        kvpos = jnp.arange(k.shape[1]) if cache is not None else positions
+        kw["bias"] = alibi_bias(hq, positions, kvpos)
     out = attn_fn(
         q, k, v, causal=True, q_offset=q_offset,
         segment_ids=segment_ids,

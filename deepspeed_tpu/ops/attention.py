@@ -67,7 +67,8 @@ def dot_product_attention(
     inference softmax kernels do the same for stability).
     ``attn_mask`` [sq, skv] bool composes with causal/segment masking
     (block-sparse layouts route through here, ops/sparse_attention.py).
-    ``bias`` [hq, sq, skv] adds to the pre-softmax logits (ALiBi).
+    ``bias`` [hq, sq, skv] or per-batch-row [b, hq, sq, skv] adds to the
+    pre-softmax logits (ALiBi).
     """
     in_dtype = q.dtype
     hq, hkv = q.shape[2], k.shape[2]
@@ -78,7 +79,8 @@ def dot_product_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
     if bias is not None:
-        logits = logits + bias[None].astype(jnp.float32)
+        bias = bias.astype(jnp.float32)
+        logits = logits + (bias if bias.ndim == 4 else bias[None])
     if logits_soft_cap is not None:
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
     if causal:

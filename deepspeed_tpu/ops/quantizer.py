@@ -17,7 +17,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .pallas import quant_kernel
+from .pallas import quant_kernel, quant_matmul as quant_mm_kernel
 
 
 class QuantizedTensor(NamedTuple):
@@ -130,31 +130,62 @@ def quantize_serving_weight(w: jnp.ndarray, fmt: str = "int8") -> ServingQuant:
     return ServingQuant(q=q, s=s.astype(jnp.float32))
 
 
-def serving_mm(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` where ``w`` may be a :class:`ServingQuant` (int8/fp8) or
-    :class:`ServingQuantFP6`: the compressed operand feeds the dot (the
-    convert/unpack fuses into the operand load) and the per-channel scale
-    applies to the output."""
+# Module-level switch for the fused Pallas dequant-matmul path.  TP serving
+# disables it: a pallas_call inside a GSPMD-partitioned program has no
+# sharding rule, so the partitioner would gather the full weight to every
+# shard — the jnp body partitions cleanly instead.
+_FUSED_SERVING = True
+
+
+def set_fused_serving(value: bool) -> None:
+    global _FUSED_SERVING
+    _FUSED_SERVING = bool(value)
+
+
+def serving_mm(x: jnp.ndarray, w, bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """``x @ w (+ bias)`` where ``w`` may be a :class:`ServingQuant`
+    (int8/fp8) or :class:`ServingQuantFP6`.
+
+    On TPU (or under the Pallas interpreter) qualifying shapes route
+    through the fused dequant-matmul kernels (``ops/pallas/quant_matmul``):
+    the compressed bytes are the ONLY weight HBM traffic, decode happens in
+    the kernel's operand-load stage, and the per-output-channel scale (and
+    ``bias``) fuse into the fp32 epilogue.  Elsewhere the jnp body runs —
+    same math, XLA-fused, bit-stable with the pre-kernel path."""
     if isinstance(w, ServingQuant):
+        if _FUSED_SERVING and quant_mm_kernel.supports_int8(x, w.q):
+            return quant_mm_kernel.quant_matmul(x, w.q, w.s, bias=bias)
         y = x @ w.q.astype(x.dtype)
-        return (y * w.s.astype(jnp.float32)).astype(x.dtype)
+        y = (y * w.s.astype(jnp.float32)).astype(x.dtype)
+        return y if bias is None else y + bias
     if isinstance(w, ServingQuantFP6):
+        if _FUSED_SERVING and quant_mm_kernel.supports_fp6(x, w.packed, w.in_dim):
+            return quant_mm_kernel.quant_matmul_fp6(
+                x, w.packed, w.s, w.in_dim, bias=bias
+            )
         codes = _fp6_unpack(w.packed, w.in_dim)
         y = x @ _fp6_decode(codes, x.dtype)
-        return (y * w.s.astype(jnp.float32)).astype(x.dtype)
-    return x @ w
+        y = (y * w.s.astype(jnp.float32)).astype(x.dtype)
+        return y if bias is None else y + bias
+    y = x @ w
+    return y if bias is None else y + bias
 
 
 class ServingQuantFP6:
     """FP6 (e2m3) serving weight: four 6-bit codes bit-packed into three
-    bytes along the contraction dim + one fp32 scale per output channel —
-    0.75 bytes/weight, the reference's TC-FPx format class
-    (``csrc/fp_quantizer``, blogs/deepspeed-fp6).  Decode is pure vector
-    arithmetic (no codebook gather): sign/exp/mantissa fields reassemble in
-    the compute dtype inside the matmul's producer fusion."""
+    uint8 byte PLANES ``[..., 3, in/4, out]`` + one fp32 scale per output
+    channel — 0.75 bytes/weight, the reference's TC-FPx format class
+    (``csrc/fp_quantizer``, blogs/deepspeed-fp6).  The pack is
+    QUARTER-STRIDED: packed row ``r`` carries the codes of weight rows
+    ``(r, K/4+r, K/2+r, 3K/4+r)``, so the fused Pallas kernel
+    (``ops/pallas/quant_matmul.py``) decodes each quarter with pure
+    elementwise bit arithmetic and contracts it against the matching
+    ``x[:, i*K/4:(i+1)*K/4]`` slice — no row interleave, no strided loads.
+    Decode is pure vector arithmetic (no codebook gather): sign/exp/
+    mantissa fields reassemble in the compute dtype inside the matmul."""
 
     def __init__(self, packed, s, in_dim: int):
-        self.packed = packed  # [..., 3*in/4, out] uint8
+        self.packed = packed  # [..., 3, in/4, out] uint8 byte planes
         self.s = s  # [..., out] fp32
         self.in_dim = int(in_dim)
 
@@ -207,25 +238,27 @@ def _fp6_decode(code: jnp.ndarray, dtype) -> jnp.ndarray:
 
 
 def _fp6_pack(codes: jnp.ndarray) -> jnp.ndarray:
-    """[..., in, out] 6-bit codes -> [..., 3*in/4, out] bytes (in % 4 == 0)."""
+    """[..., in, out] 6-bit codes -> [..., 3, in/4, out] byte planes
+    (in % 4 == 0), quarter-strided: packed row ``r`` holds the codes of
+    rows ``(r, K/4+r, K/2+r, 3K/4+r)`` so the fused kernel's unpack needs
+    no row interleave (see :class:`ServingQuantFP6`)."""
     *lead, n, out = codes.shape
-    c = codes.reshape(*lead, n // 4, 4, out)
-    c0, c1, c2, c3 = c[..., 0, :], c[..., 1, :], c[..., 2, :], c[..., 3, :]
+    c = codes.reshape(*lead, 4, n // 4, out)
+    c0, c1, c2, c3 = c[..., 0, :, :], c[..., 1, :, :], c[..., 2, :, :], c[..., 3, :, :]
     b0 = (c0 << 2) | (c1 >> 4)
     b1 = ((c1 & 0xF) << 4) | (c2 >> 2)
     b2 = ((c2 & 0x3) << 6) | c3
-    return jnp.stack([b0, b1, b2], axis=-2).reshape(*lead, 3 * n // 4, out)
+    return jnp.stack([b0, b1, b2], axis=-3)
 
 
 def _fp6_unpack(packed: jnp.ndarray, in_dim: int) -> jnp.ndarray:
-    *lead, _, out = packed.shape
-    b = packed.reshape(*lead, in_dim // 4, 3, out)
-    b0, b1, b2 = b[..., 0, :], b[..., 1, :], b[..., 2, :]
+    b0, b1, b2 = packed[..., 0, :, :], packed[..., 1, :, :], packed[..., 2, :, :]
     c0 = b0 >> 2
     c1 = ((b0 & 0x3) << 4) | (b1 >> 4)
     c2 = ((b1 & 0xF) << 2) | (b2 >> 6)
     c3 = b2 & 0x3F
-    return jnp.stack([c0, c1, c2, c3], axis=-2).reshape(*lead, in_dim, out)
+    # quarters concatenate back in row order (quarter-strided pack)
+    return jnp.concatenate([c0, c1, c2, c3], axis=-2)
 
 
 def quantize_serving_weight_fp6(w: jnp.ndarray) -> ServingQuantFP6:

@@ -18,6 +18,30 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 _CURRENT_MESH = None
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, axis_names=None,
+                     check_vma: bool = False):
+    """``jax.shard_map`` across jax versions: the top-level export (with its
+    ``check_vma``/``axis_names`` kwargs) on current jax, falling back to
+    ``jax.experimental.shard_map.shard_map`` (``check_rep``; ``axis_names``
+    expressed as its complement ``auto``) on older releases.  Every manual
+    region in the repo routes through here so a jax upgrade/downgrade is a
+    one-file concern."""
+    try:
+        from jax import shard_map as _sm
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _sm(f, **kw)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+        return _sm(f, **kw)
+
+
 def set_current_mesh(mesh) -> None:
     global _CURRENT_MESH
     _CURRENT_MESH = mesh

@@ -187,7 +187,9 @@ def make_train_step(engine):
 
     def train_step(state, batch, rng):
         m, v, errw, errs = state.opt_state
-        body = jax.shard_map(
+        from ..parallel.sharding import shard_map_compat
+
+        body = shard_map_compat(
             sharded_body,
             mesh=engine.mesh,
             in_specs=(

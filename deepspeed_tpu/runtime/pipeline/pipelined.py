@@ -307,14 +307,16 @@ def pipeline_apply(
     if tp_layer_specs is not None:
         layer_specs = tp_layer_specs
 
-    fwd_sm = jax.shard_map(
+    from ...parallel.sharding import shard_map_compat
+
+    fwd_sm = shard_map_compat(
         fwd_body,
         mesh=mesh,
         in_specs=(layer_specs, x_spec, e_spec),
         out_specs=out_spec,
         check_vma=False,
     )
-    bwd_sm = jax.shard_map(
+    bwd_sm = shard_map_compat(
         bwd_body,
         mesh=mesh,
         in_specs=(layer_specs, x_spec, e_spec, x_spec, P()),

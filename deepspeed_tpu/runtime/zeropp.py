@@ -276,7 +276,9 @@ def make_micro_value_and_grad(
             if loco
             else (P(), master_in_specs)
         )
-        mapped = jax.shard_map(
+        from ..parallel.sharding import shard_map_compat
+
+        mapped = shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(master_in_specs, err_in_specs, batch_specs, P(), P()),

@@ -139,7 +139,9 @@ class DistributedAttention:
 
         batch_entry = filter_spec((q.shape[0],), P(BATCH), mesh)[0]
         spec = P(batch_entry, SEQ_AXIS, None, None)
-        fn = jax.shard_map(
+        from ..parallel.sharding import shard_map_compat
+
+        fn = shard_map_compat(
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

@@ -135,7 +135,9 @@ def ring_attention(
         q_spec = P(q_spec[0], q_spec[1], None, None)
 
     body = functools.partial(_ring_local, axis_name=SEQ_AXIS, n_steps=sp, scale=scale)
-    fn = jax.shard_map(
+    from ..parallel.sharding import shard_map_compat
+
+    fn = shard_map_compat(
         body, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec), out_specs=q_spec,
         check_vma=False,
     )

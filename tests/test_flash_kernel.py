@@ -58,6 +58,7 @@ def test_supports_gating():
     assert not flash_kernel.supports(q2, q2, q2, True, 0, None, None)  # head dim
 
 
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
 def test_flash_segment_ids_parity():
     """Packed-sequence masking: kernel matches the dense body fwd + grads."""
     from deepspeed_tpu.ops.pallas import flash_kernel as fk
@@ -203,6 +204,7 @@ def _sparse_qkv(b, s, hq, hkv, d, seed=9):
             _rand((b, s, hkv, d), seed + 2))
 
 
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
 def test_block_sparse_kernel_matches_masked_dense():
     """Local-window layout at kernel granularity: the sparse kernel must
     equal the element-masked dense body (values AND grads), GQA included."""

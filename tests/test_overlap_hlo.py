@@ -263,6 +263,7 @@ def _domino_compile_stats(domino):
     }
 
 
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
 def test_domino_chunks_shrink_synchronous_allreduce_footprint():
     """Domino evidence, strengthened (r4 VERDICT next #8): with
     domino_chunks=2 the per-chunk dataflows are independent, so (a) the

@@ -97,6 +97,7 @@ def _engine(**kw):
     ), cfg
 
 
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
 def test_packed_prefill_matches_sequential():
     """N prompts in ONE packed dispatch produce the same first tokens and
     the same decode continuations as one-prefill-per-prompt."""

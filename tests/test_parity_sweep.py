@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu
+from deepspeed_tpu.parallel.sharding import shard_map_compat
 from simple_model import init_mlp, mlp_loss, random_batches
 
 CFG = {
@@ -157,7 +158,7 @@ def test_loco_error_feedback_converges_to_exact_mean():
             return gx, new_err
 
         return jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 body,
                 mesh=mesh,
                 in_specs=(P("fsdp"), P("fsdp"), P("fsdp")),
@@ -287,7 +288,7 @@ def test_sparse_embedding_grad_dp_reduction():
         return jax.grad(loss)(t)
 
     g_sparse = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
             check_vma=False,
         )

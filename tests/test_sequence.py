@@ -10,7 +10,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import CausalLM, get_preset
 from deepspeed_tpu.ops.attention import dot_product_attention
-from deepspeed_tpu.parallel.sharding import set_current_mesh
+from deepspeed_tpu.parallel.sharding import set_current_mesh, shard_map_compat
 from deepspeed_tpu.parallel.topology import initialize_mesh
 from deepspeed_tpu.sequence import (
     DistributedAttention,
@@ -96,7 +96,7 @@ def test_vocab_parallel_cross_entropy(seq_mesh):
         offset = idx * (v_total // 4)
         return vocab_parallel_cross_entropy(logits_shard, labels_rep, "seq", offset)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh, in_specs=(P(None, None, "seq"), P(None, None)),
         out_specs=P(), check_vma=False,
     )

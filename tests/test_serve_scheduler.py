@@ -228,6 +228,7 @@ def test_scheduler_serves_prompt_longer_than_max_bucket(tiny):
     assert len(out) == 4
 
 
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
 def test_concurrent_shared_prefix_rematches_late(tiny):
     """Requests submitted TOGETHER still share the prefix: followers are
     admitted while the cold request is writing it, and extend_match swaps
@@ -251,6 +252,7 @@ def test_concurrent_shared_prefix_rematches_late(tiny):
 # ---------------------------------------------------------------------------
 # scheduler: overload, preemption, starvation, compat
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
 def test_scheduler_overload_completes_all(tiny):
     """Submitted load far beyond pool capacity: zero failures — every
     request completes via queueing + preemption-by-recompute, with tokens
