@@ -6,5 +6,6 @@ engine_v2.py:30 + ragged state in inference/v2/ragged/).
 from .engine import InferenceEngine, init_inference  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
 from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
-from .sampling import SamplingParams, sample  # noqa: F401
+from .sampling import SamplingParams, sample, spec_verify_sample  # noqa: F401
 from .scheduler import ServeRequest, ServeScheduler  # noqa: F401
+from .speculative import propose as prompt_lookup_propose  # noqa: F401

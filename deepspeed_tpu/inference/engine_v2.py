@@ -53,6 +53,10 @@ class InferenceEngineV2:
         enable_prefix_caching: bool = False,
         prefill_chunk: Optional[int] = None,
         kv_watermark: float = 0.0625,
+        enable_speculation: bool = False,
+        spec_max_draft: int = 4,
+        spec_min_match: int = 2,
+        spec_lookup_window: int = 1024,
     ):
         self.cfg = cfg
         # Families the paged v2 path cannot serve yet must refuse loudly
@@ -168,6 +172,18 @@ class InferenceEngineV2:
         self.enable_prefix_caching = enable_prefix_caching
         self.prefill_chunk = prefill_chunk
         self.kv_watermark = kv_watermark
+        # speculative decoding (prompt-lookup drafting, inference/
+        # speculative.py): ``spec_max_draft`` candidate tokens per sequence
+        # verify in ONE target forward, ``spec_min_match`` is the n-gram
+        # that must recur in the sequence's own history to draft at all
+        if enable_speculation and spec_max_draft < 1:
+            raise ValueError("spec_max_draft must be >= 1 when speculating")
+        if enable_speculation and spec_min_match < 1:
+            raise ValueError("spec_min_match must be >= 1 when speculating")
+        self.enable_speculation = enable_speculation
+        self.spec_max_draft = spec_max_draft
+        self.spec_min_match = spec_min_match
+        self.spec_lookup_window = spec_lookup_window
         self.mgr = StateManager(num_blocks, block_size, max_seqs,
                                 enable_prefix_caching=enable_prefix_caching)
         self._scheduler = None
@@ -175,7 +191,17 @@ class InferenceEngineV2:
             "prefill_tokens_dispatched": 0,  # real prompt tokens run (not pad)
             "prefill_dispatches": 0,
             "table_uploads": 0,  # H2D copies of the block-table mirror
+            "sampling_uploads": 0,  # H2D copies of the per-slot sampling rows
             "decode_ticks": 0,
+            "decode_emitted": 0,  # tokens emitted by plain decode dispatches
+            "spec_ticks": 0,  # verify dispatches (each scores k+1 positions)
+            "spec_seq_forwards": 0,  # sequence-participations in verify ticks
+            "spec_drafted": 0,  # draft tokens proposed
+            "spec_accepted": 0,  # draft tokens accepted
+            "spec_emitted": 0,  # tokens emitted by verify ticks (acc + 1 each)
+            "spec_drafts_shed": 0,  # draft sets dropped by _spec_tick's own
+            # capacity pre-pass (direct put()/step(); scheduler sheds are
+            # counted in its drafts_shed stat)
         }
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
         # SplitFuse-style token budget: multiple prompts share one prefill
@@ -205,6 +231,11 @@ class InferenceEngineV2:
         self._tables_np = np.full((max_seqs, self.max_pages), -1, np.int32)
         self._tables_dev = None
         self._tables_dirty = True
+        # per-slot sampling rows (temperature, top_p) for the verify
+        # dispatch, dirty-tracked like the block tables: steady-state ticks
+        # where no sequence changed its sampling skip the H2D copy
+        self._samp_np = np.full((max_seqs, 2), np.nan, np.float32)
+        self._samp_dev = None
 
         # params are explicit jit arguments — closing over them would inline
         # every weight into the HLO as a constant (huge programs, no donation)
@@ -275,6 +306,28 @@ class InferenceEngineV2:
             )
             return sampled, seq_lens, rng, kv, burst, tick + 1
 
+        def spec_impl(params, tokens, seg, pos, dst_pages, dst_offs,
+                      ctx_tables, ctx_lens, draft, n_draft, samp_rows, kv,
+                      rng, top_k, all_greedy):
+            """One speculative verify tick: score every slot's
+            [last committed token | draft prefix] in a single forward, then
+            accept/resample on device (sampling.spec_verify_sample).  The
+            KV pool is donated — draft KV lands in place; rejected tails
+            are rolled back host-side by the allocator's truncate path."""
+            from .sampling import spec_verify_sample
+
+            logits, kv = model_runner.verify_packed_ctx(
+                params, cfg_, tokens, seg, pos, dst_pages, dst_offs,
+                ctx_tables, ctx_lens, kv,
+            )
+            k1 = draft.shape[1] + 1
+            logits = logits.reshape(draft.shape[0], k1, -1)
+            out, n_out = spec_verify_sample(
+                logits, draft, n_draft, samp_rows[:, 0], samp_rows[:, 1],
+                top_k, rng, all_greedy=all_greedy,
+            )
+            return out, n_out, kv
+
         if self._mesh is not None:
             # pin the result shardings so the KV pool STAYS sharded across
             # ticks (donation then reuses the buffers in place) and sampled
@@ -302,6 +355,10 @@ class InferenceEngineV2:
                 static_argnums=(9,),
                 out_shardings=(rep, rep, rep, self._kv_shardings, rep, rep),
             )
+            self._spec_jit = jax.jit(
+                spec_impl, donate_argnums=(11,), static_argnums=(13, 14),
+                out_shardings=(rep, rep, self._kv_shardings),
+            )
         else:
             self._packed_prefill_jit = self._wrap_offload(
                 jax.jit(packed_impl, donate_argnums=(6,), static_argnums=(8,)),
@@ -325,6 +382,11 @@ class InferenceEngineV2:
                     static_argnums=(9,),
                 ),
                 kv_rest_idx=4,
+            )
+            self._spec_jit = self._wrap_offload(
+                jax.jit(spec_impl, donate_argnums=(11,),
+                        static_argnums=(13, 14)),
+                kv_rest_idx=10,
             )
 
         def _cow(src: int, dst: int) -> None:
@@ -629,6 +691,195 @@ class InferenceEngineV2:
             self.stats["table_uploads"] += 1
         return self._tables_dev
 
+    def _sampling_device(self, active_seqs, sampling: SamplingParams):
+        """Device copy of the per-slot (temperature, top_p) rows, re-uploaded
+        only when some active sequence's values changed — the sampling-params
+        analogue of the dirty-tracked block tables (steady-state serving has
+        one sampling config for the whole run, so the [max_seqs, 2] H2D copy
+        per tick was pure waste).  Inactive slots keep their last rows (they
+        are masked out of every dispatch that reads this)."""
+        dirty = False
+        for s in active_seqs:
+            row = self._samp_np[s.slot]
+            # rows init to NaN, so a slot's first touch (or reuse by a new
+            # sequence) always compares unequal and re-uploads
+            if row[0] != sampling.temperature or row[1] != sampling.top_p:
+                row[0] = sampling.temperature
+                row[1] = sampling.top_p
+                dirty = True
+        if dirty or self._samp_dev is None:
+            self._samp_dev = jnp.array(self._samp_np)
+            self.stats["sampling_uploads"] += 1
+        return self._samp_dev
+
+    # -- speculative decoding ------------------------------------------------
+    def plan_speculation(
+        self, active_seqs, max_total_draft_tokens: Optional[int] = None,
+        max_emit: Optional[Dict[int, int]] = None,
+    ) -> Dict[int, List[int]]:
+        """Prompt-lookup draft proposals for one verify tick: {uid: drafts}.
+
+        Per-sequence draft length is throttled by the accept-rate EMA the
+        verify tick maintains (sequences that reject everything fall to 0 =
+        plain decode, re-probing with one token every few ticks), clamped so
+        the sequence cannot outgrow ``max_seq_len``, and capped overall by
+        ``max_total_draft_tokens`` — the scheduler passes its leftover
+        prefill-chunk budget here so chunked prefill and speculation share
+        one per-tick token headroom (drafted, not emitted, tokens count
+        against it).  ``max_emit`` caps tokens a sequence may still emit
+        (the scheduler passes each request's remaining ``max_new_tokens``):
+        a tick emits at most n_drafts + 1, so drafts clamp to max_emit - 1
+        HERE, before they debit the shared budget — a clamped-away draft
+        must not starve another sequence's proposal.  Sequences with no
+        proposal are absent from the dict.
+        """
+        from . import speculative
+
+        out: Dict[int, List[int]] = {}
+        if not self.enable_speculation:
+            return out
+        budget = (max_total_draft_tokens if max_total_draft_tokens is not None
+                  else self.mgr.max_seqs * self.spec_max_draft)
+        for s in active_seqs:
+            cap = s.spec_draft_len if s.spec_draft_len >= 0 else self.spec_max_draft
+            if cap == 0:
+                # throttled to plain decode: re-probe with a single draft
+                # token every few ticks so a sequence that BECOMES
+                # compressible (e.g. falls into a repetition loop) recovers
+                s.spec_cooldown -= 1
+                if s.spec_cooldown > 0:
+                    continue
+                cap = 1
+            cap = min(cap, self.spec_max_draft, budget,
+                      self.max_seq_len - s.cur_len - 1)
+            if max_emit is not None and s.uid in max_emit:
+                cap = min(cap, max_emit[s.uid] - 1)
+            if cap <= 0:
+                continue
+            drafts = speculative.propose(
+                s.tokens, self.spec_min_match, cap, self.spec_lookup_window
+            )
+            if drafts:
+                out[s.uid] = drafts
+                budget -= len(drafts)
+        return out
+
+    def _spec_tick(
+        self, active_seqs, sampling: SamplingParams,
+        proposals: Optional[Dict[int, List[int]]] = None,
+    ) -> Dict[int, List[int]]:
+        """One speculative tick over ``active_seqs``: draft (prompt lookup)
+        -> single-pass verify of k+1 positions per sequence -> accept ->
+        rollback.  Returns {uid: emitted tokens} — each sequence emits
+        between 1 (all drafts rejected, or none proposed: plain-decode
+        equivalent) and k+1 (all accepted + bonus) tokens, appended to its
+        descriptor.  Falls back to ``_decode_tick`` when nothing drafted
+        (no k+1-wide dispatch for incompressible batches)."""
+        if proposals is None:
+            proposals = self.plan_speculation(active_seqs)
+        # reserve pages for every position each pack would write
+        # (L-1 .. L-1+n); under pool pressure a sequence sheds its drafts
+        # and reserves only the plain-decode token, so speculation never
+        # raises where enable_speculation=False would have fit (the
+        # scheduler sheds pre-emptively; this guards direct step())
+        bs = self.block_size
+        for s in active_seqs:
+            n = len(proposals.get(s.uid, []))
+            L = s.cur_len
+            try:
+                self.mgr.ensure_capacity(s, n + 1)
+                # the COW guard belongs to the same reservation: its
+                # allocate(1) can fail a pool the capacity check fit, and it
+                # must run BEFORE the block list is read into the destination
+                # arrays (it may swap a shared page)
+                for pg in range((L - 1) // bs, (L - 1 + n) // bs + 1):
+                    self.mgr.ensure_writable(s, pg * bs)
+            except RuntimeError:
+                if not n:
+                    raise
+                proposals.pop(s.uid, None)
+                self.stats["spec_drafts_shed"] += 1
+                # release the draft-tail reservation before retrying — those
+                # blocks may be exactly what the plain-decode COW clone needs
+                self.mgr.truncate_to_length(s)
+                self.mgr.ensure_capacity(s, 1)
+                self.mgr.ensure_writable(s, L - 1)
+        if not proposals:
+            return {u: [t] for u, t in
+                    self._decode_tick(active_seqs, sampling).items()}
+        B, K = self.mgr.max_seqs, self.spec_max_draft
+        K1, bs = K + 1, self.block_size
+        tokens = np.zeros(B * K1, np.int32)
+        seg = np.zeros(B * K1, np.int32)
+        pos = np.zeros(B * K1, np.int32)
+        dst_pages = np.full(B * K1, -1, np.int32)
+        dst_offs = np.zeros(B * K1, np.int32)
+        draft = np.zeros((B, K), np.int32)
+        n_draft = np.zeros(B, np.int32)
+        ctx_lens = np.zeros(B, np.int32)
+        for s in active_seqs:
+            drafts = proposals.get(s.uid, [])
+            n = len(drafts)
+            L = s.cur_len
+            self._set_block_table(s)  # COW swaps ran in the capacity pre-pass
+            draft[s.slot, :n] = drafts
+            n_draft[s.slot] = n
+            ctx_lens[s.slot] = s.seen_tokens
+            for i in range(n + 1):
+                p_tok = L - 1 + i
+                row = s.slot * K1 + i
+                tokens[row] = s.tokens[-1] if i == 0 else drafts[i - 1]
+                seg[row] = s.slot + 1
+                pos[row] = p_tok
+                dst_pages[row] = s.blocks[p_tok // bs]
+                dst_offs[row] = p_tok % bs
+        self._rng, sub = jax.random.split(self._rng)
+        out_dev, n_out_dev, self.kv = self._spec_jit(
+            self.params, jnp.asarray(tokens), jnp.asarray(seg),
+            jnp.asarray(pos), jnp.asarray(dst_pages), jnp.asarray(dst_offs),
+            self._tables_device(), jnp.asarray(ctx_lens), jnp.asarray(draft),
+            jnp.asarray(n_draft), self._sampling_device(active_seqs, sampling),
+            self.kv, sub, sampling.top_k, sampling.temperature <= 0.0,
+        )
+        self.stats["spec_ticks"] += 1
+        self.stats["spec_seq_forwards"] += len(active_seqs)
+        out_np, n_out = np.asarray(out_dev), np.asarray(n_out_dev)
+        out: Dict[int, List[int]] = {}
+        for s in active_seqs:
+            n_emit = int(n_out[s.slot])
+            emitted = [int(t) for t in out_np[s.slot, :n_emit]]
+            n = int(n_draft[s.slot])
+            n_acc = n_emit - 1
+            s.tokens.extend(emitted)
+            s.seen_tokens = s.cur_len - 1
+            # rollback: free tail blocks the rejected drafts reserved (their
+            # garbage KV rows inside KEPT blocks are masked by length and
+            # overwritten as the sequence grows — the step_n rule)
+            if self.mgr.truncate_to_length(s):
+                self._set_block_table(s)
+            self.mgr.update_hashes(s)
+            self.stats["spec_drafted"] += n
+            self.stats["spec_accepted"] += n_acc
+            self.stats["spec_emitted"] += n_emit
+            s.spec_drafted += n
+            s.spec_accepted += n_acc
+            if n > 0:
+                self._spec_update_throttle(s, n, n_acc)
+            out[s.uid] = emitted
+        return out
+
+    def _spec_update_throttle(self, s, n: int, n_acc: int) -> None:
+        """Fold one verify tick's (drafted, accepted) into the sequence's
+        accept-rate EMA and recompute its draft-length cap.  A sequence
+        rejecting everything decays to 0 (= plain decode) within ~3
+        consecutive full-rejection ticks and re-probes with a single draft
+        token after the cooldown; acceptance grows the cap back toward
+        ``spec_max_draft``."""
+        s.spec_ema = 0.5 * s.spec_ema + 0.5 * (n_acc / n)
+        s.spec_draft_len = int(round(s.spec_ema * self.spec_max_draft))
+        if s.spec_draft_len == 0:
+            s.spec_cooldown = 8
+
     def _decode_tick(self, active_seqs, sampling: SamplingParams) -> Dict[int, int]:
         """One batched decode dispatch over ``active_seqs`` only (other
         tracked sequences keep their KV untouched — the scheduler decodes
@@ -655,6 +906,7 @@ class InferenceEngineV2:
             sub, (sampling.temperature, sampling.top_k, sampling.top_p),
         )
         self.stats["decode_ticks"] += 1
+        self.stats["decode_emitted"] += len(active_seqs)
         next_tokens = np.asarray(sampled)
         out = {}
         for s in active_seqs:
@@ -667,17 +919,32 @@ class InferenceEngineV2:
 
     def step(self, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
         """One batched decode tick over all active sequences; returns the
-        next token per uid (sequences at their stop token are skipped)."""
+        newest token per uid (sequences at their stop token are skipped).
+        With ``enable_speculation`` a tick may emit SEVERAL tokens per
+        sequence (drafts accepted by the verify pass) — all are appended to
+        the descriptor, the newest is returned, and a stop token inside the
+        emitted run truncates the sequence there."""
         active_seqs = [s for s in self.mgr.active if not s.done]
         if not active_seqs:
             return {}
-        out = self._decode_tick(active_seqs, sampling)
+        if self.enable_speculation:
+            runs = self._spec_tick(active_seqs, sampling)
+        else:
+            runs = {u: [t] for u, t in
+                    self._decode_tick(active_seqs, sampling).items()}
+        out = {}
         for s in active_seqs:
-            tok = out[s.uid]
-            if sampling.stop_token is not None and tok == sampling.stop_token:
+            run = runs[s.uid]
+            if sampling.stop_token is not None and sampling.stop_token in run:
+                cut = len(run) - run.index(sampling.stop_token) - 1
+                if cut:  # drop speculated tokens past the stop
+                    del s.tokens[-cut:]
+                    run = run[:-cut]
+                    s.seen_tokens = min(s.seen_tokens, s.cur_len - 1)
                 s.done = True
             if s.cur_len >= self.max_seq_len:
                 s.done = True
+            out[s.uid] = run[-1]
         return out
 
     def step_n(self, n: int, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:

@@ -32,6 +32,7 @@ from .paged import (
     paged_attention_packed_ctx,
     write_decode_kv,
     write_prefill_kv,
+    write_spec_kv,
 )
 
 Params = Any
@@ -288,6 +289,78 @@ def prefill_packed_ctx(
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
     logits = _lm_logits(params, cfg, last)  # [N, v]
+    return logits, (tuple(new_ck), tuple(new_cv))
+
+
+def verify_packed_ctx(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [T] int32 — per slot: [last committed, d_0..d_{k-1}], padded
+    segment_ids: jnp.ndarray,  # [T] int32 — slot+1 per valid token, 0 = padding
+    positions: jnp.ndarray,  # [T] int32 — ABSOLUTE position of each token
+    dst_pages: jnp.ndarray,  # [T] int32 — KV destination page per token (-1 pad)
+    dst_offs: jnp.ndarray,  # [T] int32 — row within the destination page
+    ctx_tables: jnp.ndarray,  # [N, P] int32 — block table per slot (-1 pad)
+    ctx_lens: jnp.ndarray,  # [N] int32 — committed (KV-written) length per slot
+    kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+):
+    """Speculative-decode verify: score k+1 positions per sequence in ONE
+    pass — the dispatch that amortizes the weight stream across several
+    emitted tokens (one weight read serves up to k+1 of them).
+
+    Each sequence's pack segment is [its last committed token, then its k
+    draft tokens] at consecutive absolute positions; attention rides the
+    same machinery as chunked prefill (``paged_attention_packed_ctx``): one
+    softmax over [cached context | in-pack causal draft prefix], so a draft
+    token attends over the sequence's cached pages plus the drafts before
+    it.  Two differences from ``prefill_packed_ctx``:
+
+    * KV writes are per-ROW scatters (``write_spec_kv``): the pack starts
+      mid-page at the decode head, where a page-granular scatter would
+      stomp live rows.  Rejected drafts leave garbage KV past the accepted
+      length — masked by sequence length everywhere, overwritten as the
+      sequence grows (the ``step_n`` rule), and their tail BLOCKS are freed
+      by the allocator's truncate path.
+    * Logits return for ALL T pack rows (each one verifies the next draft
+      or samples the correction/bonus token), not just a per-segment last
+      row.  The [T, vocab] fp32 buffer is the price of single-pass verify —
+      T = max_seqs * (k+1) stays small next to prefill packs.
+
+    Returns (logits [T, v], new caches).
+    """
+    t = tokens.shape[0]
+    x = params["embed"]["embedding"][tokens][None].astype(cfg.dtype)  # [1,T,d]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"]["embedding"][
+            jnp.clip(positions, 0, cfg.max_seq_len - 1)
+        ][None].astype(cfg.dtype)
+    x = _embed(params, cfg, x)
+    ck, cv = kv_cache
+    pos2 = positions[None]
+    new_ck, new_cv = list(ck), list(cv)
+    for l in range(cfg.num_layers):
+        lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
+        q, k, v = _qkv(lw["attn"], h, cfg)
+        if cfg.position == "rope":
+            q = rope(q, pos2, cfg.rope_theta)
+            k = rope(k, pos2, cfg.rope_theta)
+        new_ck[l] = write_spec_kv(new_ck[l], k[0], dst_pages, dst_offs)
+        new_cv[l] = write_spec_kv(new_cv[l], v[0], dst_pages, dst_offs)
+        # context positions (< ctx_lens) read the cached pools; the pack's
+        # freshly written rows are masked out by ctx_lens and enter through
+        # the in-pack causal half — same split as prefill_packed_ctx
+        attn = paged_attention_packed_ctx(
+            q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
+            ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
+        )
+        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
+        x = x + attn.astype(x.dtype)
+        h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
+        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+
+    x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
+    logits = _lm_logits(params, cfg, x[0])  # [T, v]
     return logits, (tuple(new_ck), tuple(new_cv))
 
 

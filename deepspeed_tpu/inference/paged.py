@@ -70,6 +70,28 @@ def write_decode_kv(cache_layer, kv, block_table, positions, active):
     return cache_layer.at[sentinel, off].set(kv.astype(cache_layer.dtype), mode="drop")
 
 
+def write_spec_kv(cache_layer, kv, pages, offsets):
+    """Scatter a speculative verify pack's K (or V) rows token-by-token.
+
+    cache_layer [num_blocks, bs, hkv, hd]; kv [T, hkv, hd]; pages/offsets
+    [T] int32 — destination (page, row) per packed token, ``pages`` -1 for
+    padding rows (dropped via the out-of-bounds sentinel, same rule as
+    ``write_decode_kv``).
+
+    Unlike chunked prefill, a verify pack starts MID-PAGE (the sequence's
+    next write position is whatever decode left it at), so the page-granular
+    ``at[pages].set`` trick of ``prefill_packed`` would stomp live rows at
+    the head of the first page.  A row scatter is exact; verify packs are
+    small — max_seqs * (k+1) rows, nowhere near the 2048-token prefill packs
+    where per-row scatters were measured to serialize.
+    """
+    nb = cache_layer.shape[0]
+    sentinel = jnp.where(pages >= 0, pages, nb)
+    return cache_layer.at[sentinel, offsets].set(
+        kv.astype(cache_layer.dtype), mode="drop"
+    )
+
+
 def paged_attention_packed_ctx(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
     scale=None, logits_soft_cap=None,

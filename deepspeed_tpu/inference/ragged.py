@@ -222,6 +222,14 @@ class SequenceDescriptor:
     done: bool = False
     cached_tokens: int = 0  # prefix tokens served from the block cache
     hashes: List[object] = field(default_factory=list)  # chained full-block keys
+    # speculative-decoding state (engine_v2 drives these): accept-rate EMA
+    # feeds the per-sequence draft-length throttle; a throttled-to-0
+    # sequence decodes plainly and re-probes after spec_cooldown ticks
+    spec_draft_len: int = -1  # current draft cap; -1 = unset (engine max)
+    spec_ema: float = 1.0  # accept-rate EMA (optimistic start)
+    spec_cooldown: int = 0  # plain-decode ticks left before a re-probe
+    spec_drafted: int = 0  # lifetime drafted tokens (stats)
+    spec_accepted: int = 0  # lifetime accepted tokens (stats)
 
     @property
     def cur_len(self) -> int:
@@ -329,6 +337,34 @@ class StateManager:
         seq.blocks[i] = new
         del seq.hashes[i:]  # content diverges from the published chain here
         self.cow_copies += 1
+
+    def truncate_to_length(self, seq: SequenceDescriptor,
+                           n_tokens: Optional[int] = None) -> int:
+        """Free the block tail beyond what ``n_tokens`` (default: the
+        sequence's current length) needs — the speculative-rollback path.
+
+        A verify pass reserves pages for the full draft (``ensure_capacity``
+        over k+1 tokens); when most drafts are rejected those tail slots
+        would otherwise stay allocated until the sequence grew into them,
+        silently shrinking the pool every speculating sequence by up to
+        ``ceil(k/block_size)`` blocks.  Freeing goes through the allocator's
+        normal deref (``free``), so a tail block that happens to be shared
+        or prefix-cached just drops one reference — cached-LRU membership,
+        other sequences' refcounts, and the published hash chains of KEPT
+        blocks are untouched.  The sequence's own hash list is clipped to
+        the kept range (it never extends past committed full blocks, so
+        this is a no-op outside defensive cases).  Returns blocks freed.
+        """
+        if n_tokens is None:
+            n_tokens = seq.cur_len
+        keep = -(-n_tokens // self.block_size)
+        if len(seq.blocks) <= keep:
+            return 0
+        tail = seq.blocks[keep:]
+        del seq.blocks[keep:]
+        del seq.hashes[keep:]
+        self.allocator.free(tail)
+        return len(tail)
 
     def extend_match(self, seq: SequenceDescriptor) -> None:
         """Late re-match: blocks published AFTER this sequence was admitted

@@ -22,6 +22,127 @@ class SamplingParams:
     stop_token: Optional[int] = None
 
 
+def _greedy_onehot(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax as log-probs: 0 at the argmax, -inf elsewhere — the greedy
+    distribution both the all-greedy fast path and per-row greedy override
+    must agree on (divergence would break greedy token identity)."""
+    return jnp.where(
+        jnp.arange(logits.shape[-1]) == jnp.argmax(logits, axis=-1)[..., None],
+        0.0, -jnp.inf,
+    )
+
+
+def filtered_log_probs(
+    logits: jnp.ndarray,  # [..., v] raw fp32 logits
+    temps: jnp.ndarray,  # [B] — rows with temp <= 0 become one-hot argmax
+    top_ps: jnp.ndarray,  # [B] — 1.0 disables
+    top_k: int,  # static; 0 disables
+    all_greedy: bool = False,  # static: whole batch is greedy
+) -> jnp.ndarray:
+    """Per-ROW temperature/top-p (static top-k) filtering to log-probs.
+
+    The batched counterpart of ``sample``'s scalar filtering, shaped for
+    speculative verify: logits [B, k+1, v] with one (temperature, top_p)
+    pair per sequence row.  Greedy rows (temp <= 0) return the one-hot
+    argmax in log space (0 at the argmax, -inf elsewhere), which makes the
+    acceptance rule below collapse to exact token match and the final
+    categorical draw collapse to argmax — one code path serves both.
+
+    ``all_greedy`` is a STATIC promise that every row is greedy — the
+    filter pipeline below (a full descending vocab sort + softmax/cumsum)
+    would be traced only to have every output discarded by the one-hot
+    override, so the caller who knows the batch shares one greedy config
+    (the engine's single-SamplingParams ticks) skips it at trace time.
+    """
+    if all_greedy:
+        return _greedy_onehot(logits)
+    greedy = temps <= 0.0
+    t = jnp.where(greedy, 1.0, temps)
+    l = logits.astype(jnp.float32) / t[:, None, None]
+    # ONE descending vocab sort serves both filters: the top-k threshold is
+    # the k-th sorted entry, and value-masking (< kth -> -inf, ties kept —
+    # same rule as sample()) hits exactly the sorted tail, so masking the
+    # sorted array in place equals sorting the masked array
+    sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
+    if top_k > 0:
+        k = min(top_k, l.shape[-1])
+        kth = sorted_l[..., k - 1][..., None]
+        l = jnp.where(l < kth, -jnp.inf, l)
+        sorted_l = jnp.where(sorted_l < kth, -jnp.inf, sorted_l)
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # smallest prefix with cumulative prob >= top_p (same rule as sample();
+    # top_p = 1.0 keeps everything because cum's final entry is never < 1)
+    cutoff_idx = jnp.sum(cum < top_ps[:, None, None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[..., None], axis=-1)
+    l = jnp.where(l < cutoff, -jnp.inf, l)
+    logp = jax.nn.log_softmax(l, axis=-1)
+    return jnp.where(greedy[:, None, None], _greedy_onehot(logits), logp)
+
+
+def spec_verify_sample(
+    logits: jnp.ndarray,  # [B, k+1, v] — verify logits, position-ordered
+    draft: jnp.ndarray,  # [B, k] int32 — proposed draft tokens
+    n_draft: jnp.ndarray,  # [B] int32 — valid drafts per row (0 = plain decode)
+    temps: jnp.ndarray,  # [B] per-row temperature (<= 0 greedy)
+    top_ps: jnp.ndarray,  # [B] per-row top-p
+    top_k: int,  # static top-k (shared across the batch)
+    rng: jax.Array,
+    all_greedy: bool = False,  # static: skip the filter pipeline entirely
+):
+    """Distribution-preserving speculative acceptance (rejection sampling).
+
+    The prompt-lookup drafter is deterministic, so the draft distribution q
+    is a point mass on the proposed token and the classic speculative
+    sampling rule simplifies: accept draft d_i with probability
+    p_i(d_i) (= min(1, p/q) with q = 1); on the first rejection resample
+    from the residual norm(max(p - q, 0)) — p_i with d_i's mass removed;
+    if every draft survives, sample the BONUS token from p_{k+1}.  Each
+    target forward therefore emits n_accepted + 1 tokens, and the emitted
+    stream is distributed exactly as plain autoregressive sampling from p
+    (greedy rows: p is the one-hot argmax, so acceptance is exact token
+    match and the correction token is the argmax — token-identical to
+    baseline greedy decode).
+
+    Returns (out_tokens [B, k+1] int32 — first n_out valid, rest 0;
+    n_out [B] int32 = accepted + 1).
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    logp = filtered_log_probs(
+        logits, temps, top_ps, top_k, all_greedy=all_greedy
+    )  # [B, k+1, v]
+    probs = jnp.exp(logp)
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], draft[..., None], axis=-1
+    )[..., 0]  # [B, k]
+    rng_u, rng_f = jax.random.split(rng)
+    u = jax.random.uniform(rng_u, (b, k))
+    # u < 1 always, so p(d) = 1 (greedy match, or the whole filtered mass
+    # on d) always accepts — a rejection therefore always leaves residual
+    # mass to resample from
+    acc = (u < p_draft) & (jnp.arange(k)[None, :] < n_draft[:, None])
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=-1), axis=-1)
+    j = n_acc  # first-rejection position, or n_draft (bonus position)
+    dist_j = jnp.take_along_axis(logp, j[:, None, None], axis=1)[:, 0]  # [B,v]
+    d_j = jnp.take_along_axis(
+        draft, jnp.clip(j, 0, max(k - 1, 0))[:, None], axis=-1
+    )[:, 0] if k > 0 else jnp.zeros((b,), jnp.int32)
+    rejected = j < n_draft
+    dist_j = jnp.where(
+        rejected[:, None] & (jnp.arange(v)[None, :] == d_j[:, None]),
+        -jnp.inf, dist_j,
+    )  # residual: drop the rejected draft's mass, renormalized by categorical
+    final = jax.random.categorical(rng_f, dist_j, axis=-1).astype(jnp.int32)
+    idx = jnp.arange(k1)[None, :]
+    draft_pad = jnp.pad(draft, ((0, 0), (0, 1)))
+    out = jnp.where(
+        idx < n_acc[:, None], draft_pad,
+        jnp.where(idx == n_acc[:, None], final[:, None], 0),
+    ).astype(jnp.int32)
+    return out, (n_acc + 1).astype(jnp.int32)
+
+
 def sample(logits: jnp.ndarray, params: SamplingParams, rng: jax.Array) -> jnp.ndarray:
     """logits [B, v] -> token ids [B]."""
     if params.temperature <= 0.0:

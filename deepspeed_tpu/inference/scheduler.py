@@ -86,9 +86,11 @@ class ServeScheduler:
         self.tick_no = 0
         self._triple = None  # shared device sampling triple
         self._uid_counter = 0
+        self._spec_budget = self.prefill_chunk  # leftover chunk tokens/tick
         self.stats = {
             "submitted": 0, "finished": 0, "admissions": 0,
             "preemptions": 0, "queue_wait_ticks": 0, "prefill_chunks": 0,
+            "drafts_shed": 0,  # draft sets dropped under pool pressure
         }
 
     # -- request intake -----------------------------------------------------
@@ -222,6 +224,12 @@ class ServeScheduler:
                     continue
             entries.append((seq, start, start + take))
             budget -= take
+        # leftover chunk tokens become this tick's speculative-draft budget:
+        # drafting k tokens costs a k+1-position verify forward, so DRAFTED
+        # tokens (not emitted ones) share the admission headroom chunked
+        # prefill already accounts in — a tick saturated by prompt chunks
+        # speculates less, an idle-prefill tick speculates up to the chunk
+        self._spec_budget = max(0, budget)
         if not entries:
             return out
         first = self.engine.prefill_entries(entries, self._base_sampling())
@@ -257,34 +265,65 @@ class ServeScheduler:
 
     def _decode_phase(self, decoding: List[ServeRequest]) -> Dict[int, int]:
         out: Dict[int, int] = {}
-        mgr = self.engine.mgr
+        eng = self.engine
+        mgr = eng.mgr
+        # draft proposals for this tick, bounded by the prefill chunk's
+        # leftover token budget (speculation and chunked prefill share one
+        # per-tick headroom, accounted in DRAFTED tokens); per-request
+        # remaining max_new_tokens clamps inside plan_speculation so
+        # clamped-away drafts never debit the shared budget
+        decode_live = [r for r in decoding if r.state == DECODE]
+        proposals = eng.plan_speculation(
+            [mgr.seqs[r.uid] for r in decode_live],
+            max_total_draft_tokens=self._spec_budget,
+            max_emit={r.uid: r.sampling.max_new_tokens - len(r.generated)
+                      for r in decode_live},
+        ) if eng.enable_speculation else {}
         for req in decoding:
             if req.state != DECODE:  # preempted by an earlier victim pick
                 continue
             seq = mgr.seqs[req.uid]
             while True:
                 try:
-                    mgr.ensure_capacity(seq, 1)
+                    mgr.ensure_capacity(seq, 1 + len(proposals.get(req.uid, ())))
                     mgr.ensure_writable(seq, seq.cur_len - 1)
                     break
                 except RuntimeError:
+                    # shed this request's own in-flight drafts before
+                    # preempting anyone — speculation is optional, residency
+                    # is not (plain decode needs only one page of growth)
+                    if proposals.pop(req.uid, None):
+                        self.stats["drafts_shed"] += 1
+                        continue
                     victim = self._pick_victim(exclude=req)
                     if victim is None:
                         raise RuntimeError(
                             "KV pool cannot hold even one growing sequence "
                             f"({mgr.allocator.total_blocks} blocks)"
                         ) from None
+                    # a preempted victim's drafts die with its pages — its
+                    # committed tokens requeue, the proposal never runs
+                    proposals.pop(victim.uid, None)
                     self._preempt(victim)
         survivors = [r for r in decoding if r.state == DECODE]
         if not survivors:
             return out
-        toks = self.engine._decode_tick(
-            [mgr.seqs[r.uid] for r in survivors], self._base_sampling()
-        )
+        seqs = [mgr.seqs[r.uid] for r in survivors]
+        if eng.enable_speculation:
+            runs = eng._spec_tick(seqs, self._base_sampling(), proposals)
+        else:
+            runs = {u: [t] for u, t in
+                    eng._decode_tick(seqs, self._base_sampling()).items()}
         for req in survivors:
-            tok = toks[req.uid]
-            req.generated.append(tok)
-            out[req.uid] = tok
+            emitted = runs[req.uid]
+            stop = req.sampling.stop_token
+            if stop is not None and stop in emitted:
+                # tokens speculated past the stop are dropped from the
+                # request; the descriptor's extras vanish when the finished
+                # sequence releases its state
+                emitted = emitted[: emitted.index(stop) + 1]
+            req.generated.extend(emitted)
+            out[req.uid] = emitted[-1]
             self._maybe_finish(req)
         return out
 

@@ -1,0 +1,74 @@
+"""Speculative decoding: prompt-lookup (n-gram self-speculation) drafting.
+
+Autoregressive decode is weight-bandwidth-bound — every emitted token pays
+one full weight-stream read per sequence (the serve8b roofline study).
+Speculative decoding amortizes that read: draft ``k`` cheap candidate
+tokens, then score all ``k + 1`` positions in ONE target forward
+(``model_runner.verify_packed_ctx``) and keep the longest prefix the target
+distribution accepts.  With distribution-preserving acceptance
+(``sampling.spec_verify_sample``) the emitted stream is exactly the target
+model's — greedy speculation is token-identical to plain greedy decode, and
+temperature/top-p speculation samples the same distribution.
+
+The drafter here is **prompt lookup** (n-gram self-speculation; the
+"assisted generation without a draft model" trick): the candidate
+continuation is read out of the sequence's OWN token history — prompt plus
+everything generated so far.  No second model, no extra weights, nothing to
+train, fully deterministic, and it runs on the host between device ticks.
+It shines exactly where serving traffic repeats itself: summarization /
+extraction / code-edit workloads that copy prompt spans, and the degenerate
+repetition loops untrained-or-greedy models fall into.  On adversarial
+(incompressible) streams it proposes little or nothing and the engine
+transparently degrades to plain decode — the per-sequence throttle in
+``engine_v2`` drives the draft length to 0 for sequences that reject
+everything.
+
+Host-side and stateless: ``propose()`` is a pure function of the token
+list, so preemption-by-recompute, prefix-cache swaps, and uid reuse need no
+cache invalidation here.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def propose(
+    tokens: Sequence[int],
+    min_match: int,
+    max_draft: int,
+    lookup_window: int = 1024,
+) -> List[int]:
+    """Draft up to ``max_draft`` tokens by prompt lookup.
+
+    Finds the most recent earlier occurrence of the sequence's final
+    ``min_match``-gram inside the last ``lookup_window`` tokens and proposes
+    the continuation that followed it.  When the match overlaps the tail —
+    i.e. the sequence is periodic with period ``p < min_match + max_draft``
+    (greedy repetition loops are the common case) — the continuation is
+    extended by cycling the period, so even a period-1 loop yields a full
+    ``max_draft``-token draft instead of a single token.
+
+    Pure function of ``tokens``: O(window * min_match) reverse scan, no
+    per-sequence index to invalidate across preemption or uid reuse.
+    Returns ``[]`` when the history is too short or no n-gram recurs.
+    """
+    n = len(tokens)
+    if max_draft <= 0 or min_match <= 0 or n < min_match + 1:
+        return []
+    suffix = tuple(tokens[-min_match:])
+    lo = max(0, n - lookup_window)
+    # scan newest-first; the suffix itself starts at n - min_match, so the
+    # newest admissible match starts one position earlier
+    for i in range(n - min_match - 1, lo - 1, -1):
+        if tuple(tokens[i:i + min_match]) != suffix:
+            continue
+        start = i + min_match
+        period = (n - min_match) - i  # distance match -> tail
+        out: List[int] = []
+        for j in range(max_draft):
+            idx = start + j
+            while idx >= n:  # continuation runs off the end: cycle the period
+                idx -= period
+            out.append(int(tokens[idx]))
+        return out
+    return []
