@@ -121,8 +121,10 @@ def _spec_serve_section(
     the allocator leak check (audit + every block back in free/cached after
     the run) gates the JSON.  Prints one line with accept rate,
     emitted-tokens-per-target-forward, and effective tok/s vs the plain
-    (PR 2) baseline."""
+    (PR 2) baseline, plus the telemetry percentile table (TTFT/TBT/queue
+    wait/per-request accept rate) of the spec run."""
     from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.telemetry import format_percentile_table, percentile_summary
 
     rng = np.random.default_rng(0)
     pattern = rng.integers(1, cfg.vocab_size, 8).tolist()
@@ -133,12 +135,24 @@ def _spec_serve_section(
     }
     samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
 
-    def run(speculate):
-        eng = make_engine(speculate)
+    def run(speculate, telemetry=False):
+        # the TIMED plain-vs-spec pair runs telemetry-free so the speedup
+        # ratio and tokens/s stay comparable to the PR 4 baseline; a third
+        # telemetry-on spec run supplies the percentile table
+        eng = make_engine(speculate, telemetry=telemetry)
         sched = eng.scheduler
-        # warmup compiles every dispatch shape outside the timed window
-        warm = rng.integers(1, cfg.vocab_size, base_len).tolist()
-        sched.submit(10_001, warm + pattern * 2, samp)
+        # shape REHEARSAL outside the timed window: pack shapes vary with
+        # the number of packed entries, so replay the measured workload's
+        # exact structure (same lengths + pattern tails, fresh bases) — this
+        # compiles the multi-entry packs, the ctx re-prefills preemption
+        # triggers, and (with tails) the drafter's verify path
+        for u in range(1, n_req + 1):
+            sched.submit(
+                10_000 + u,
+                rng.integers(1, cfg.vocab_size, base_len).tolist()
+                + pattern * (rep_len // 8),
+                samp,
+            )
         sched.run()
         if speculate:
             # the warm request only reaches the verify dispatch if its
@@ -150,7 +164,12 @@ def _spec_serve_section(
             s.tokens[-1] = s.tokens[-1 - len(pattern)]
             eng.step(samp)
             eng.flush([10_002])
+        # the warmup's traces carry compile time — drop them so the
+        # percentile table describes only the measured window (counters
+        # are baselined by the stats0 diff below instead)
+        eng.telemetry.reset_window()
         stats0 = dict(eng.stats)
+        sched0 = dict(sched.stats)  # the rehearsal preempted/shed too
         t0 = time.perf_counter()
         for u, p in prompts.items():
             sched.submit(u, p, samp)
@@ -162,13 +181,23 @@ def _spec_serve_section(
         leak_ok = (in_use == 0 and alloc.free_blocks + alloc.cached_blocks
                    == alloc.total_blocks)
         d = {k: eng.stats[k] - stats0.get(k, 0) for k in eng.stats}
+        sd = {k: sched.stats[k] - sched0.get(k, 0) for k in sched.stats}
         total = sum(len(p) for p in prompts.values()) + sum(
             len(r) for r in res.values()
         )
-        return res, dt, d, sched.stats, leak_ok, total
+        return res, dt, d, sd, leak_ok, total, eng.telemetry
 
-    plain_res, plain_dt, _, _, plain_leak, total_tokens = run(False)
-    spec_res, spec_dt, d, sstats, spec_leak, _ = run(True)
+    plain_res, plain_dt, _, _, plain_leak, total_tokens, _ = run(False)
+    spec_res, spec_dt, d, sstats, spec_leak, _, _ = run(True)
+    tel_res, _, _, _, _, _, spec_tel = run(True, telemetry=True)
+    assert tel_res == spec_res  # observation does not change tokens
+    spec_tel.flush()  # settle any deferred intermediate-chunk spans
+    pct = percentile_summary(spec_tel.registry, (
+        "serve/ttft_ms", "serve/tbt_ms", "serve/queue_wait_ms",
+        "serve/e2e_ms", "serve/request_accept_rate",
+    ))
+    print(format_percentile_table(
+        pct, title="spec serve latency percentiles (telemetry twin)"))
 
     # per-SEQUENCE forwards: a plain decode dispatch contributes one forward
     # (and one token) per participating sequence, a verify dispatch one
@@ -201,6 +230,7 @@ def _spec_serve_section(
             "drafts_shed": sstats["drafts_shed"],
             "allocator_leak_check": "pass" if (spec_leak and plain_leak) else "fail",
             "spec_vs_plain_token_identical": identical,
+            "latency_percentiles": pct,
         },
     }
     if extra_extra:
@@ -214,8 +244,12 @@ def serving_main(quant=None, spec=False, smoke=False):
     chip (`python bench.py --serving [--quant int8|fp8]`).  Prints one JSON
     line; not the driver's flagship metric — the serving counterpart for
     the README.  With `--spec` it instead runs the speculative-decoding
-    serve study (repetitive-suffix workload, spec on vs off; `--smoke`
-    shrinks it to the CI fast-lane size)."""
+    serve study (repetitive-suffix workload, spec on vs off).  `--smoke`
+    shrinks every path to the CI fast-lane size.  The serve-loop section
+    runs with telemetry enabled: it prints the TTFT/TBT/queue-wait
+    percentile table, embeds the same figures in the JSON payload, and (on
+    the smoke/CPU sizes) re-runs the identical workload with telemetry
+    disabled to assert the stats counters are regression-free."""
     from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.inference.sampling import SamplingParams
     from deepspeed_tpu.models import get_preset
@@ -244,11 +278,11 @@ def serving_main(quant=None, spec=False, smoke=False):
                        prefill_budget=64, prefill_chunk=32)
             check_identity = True
 
-        def make_engine(speculate):
+        def make_engine(speculate, telemetry=False):
             return InferenceEngineV2(
                 sparams, scfg, enable_prefix_caching=True,
                 enable_speculation=speculate, spec_max_draft=4,
-                quantize_weights=quant, **ekw,
+                quantize_weights=quant, telemetry=telemetry, **ekw,
             )
 
         _spec_serve_section(
@@ -257,7 +291,7 @@ def serving_main(quant=None, spec=False, smoke=False):
             check_identity=check_identity, **sizes,
         )
         return
-    if on_tpu:
+    if on_tpu and not smoke:
         cfg = get_preset("llama3_proxy_410m")
         B, blocks, prompt_len, decode_steps = 64, 2048, 128, 64
     else:
@@ -318,62 +352,112 @@ def serving_main(quant=None, spec=False, smoke=False):
     # queue/preemption machinery end-to-end.  The metric is EFFECTIVE
     # throughput — prompt + generated tokens completed per wall second —
     # the FastGen-style number batching + prefix reuse actually move.
-    if on_tpu:
+    if on_tpu and not smoke:
         scfg, sdtype = cfg, jnp.bfloat16
         sparams = params
         n_req, sys_len, sfx_len, max_new = 16, 512, 64, 32
         serve_blocks = 192
-    else:  # CPU smoke: fp32 so the cold-vs-hit token-identity check is exact
+    else:  # CPU/smoke: fp32 so the cold-vs-hit token-identity check is exact
         scfg = get_preset("tiny", max_seq_len=1024, dtype=jnp.float32)
         sdtype = jnp.float32
         sparams = init_params(jax.random.PRNGKey(0), cfg=scfg, dtype=sdtype)
         n_req, sys_len, sfx_len, max_new = 8, 512, 64, 16
         serve_blocks = 96
 
-    def serve_engine():
+    def serve_engine(telemetry=False):
         return InferenceEngineV2(
             sparams, scfg, max_seqs=8, num_blocks=serve_blocks, block_size=32,
             max_seq_len=704, prefill_buckets=(64, 128, 256),
             prefill_budget=256, prefill_chunk=256, enable_prefix_caching=True,
+            telemetry=telemetry,
         )
 
-    rng = np.random.default_rng(0)
-    sys_prompt = rng.integers(1, scfg.vocab_size, sys_len).tolist()
-    prompts = {
-        u: sys_prompt + rng.integers(1, scfg.vocab_size, sfx_len).tolist()
-        for u in range(1, n_req + 1)
-    }
     serve_samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
-    seng = serve_engine()
-    sched = seng.scheduler
-    # warmup compiles every dispatch shape on an unrelated prompt (its cache
-    # entries are evictable and hash-disjoint from the workload's)
-    sched.submit(10_001, rng.integers(1, scfg.vocab_size, sys_len + sfx_len).tolist(),
-                 serve_samp)
-    sched.run()
-    cold_tokens = seng.stats["prefill_tokens_dispatched"]
-    wait0 = sched.stats["queue_wait_ticks"]
-    prompt0, cached0 = seng.mgr.prompt_tokens_total, seng.mgr.cached_prompt_tokens
 
-    # offset by the warmup's ticks, or every arrival is already in the past
-    arrivals = sched.tick_no + np.cumsum(rng.poisson(2.0, n_req))
-    submitted = 0
-    t0 = time.perf_counter()
-    while submitted < n_req or not sched.idle:
-        while submitted < n_req and arrivals[submitted] <= sched.tick_no:
-            submitted += 1
-            sched.submit(submitted, prompts[submitted], serve_samp)
-        sched.tick()
-    serve_dt = time.perf_counter() - t0
-    results = {u: sched.pop_result(u) for u in range(1, n_req + 1)}
-    assert all(len(r) == max_new for r in results.values()), "requests failed"
+    def run_serve(telemetry):
+        """One full shared-prefix arrival run on a fresh engine.  Fresh
+        numpy rng + seeded engine PRNG per run, so the telemetry-on run and
+        its disabled twin see byte-identical workloads."""
+        rng = np.random.default_rng(0)
+        sys_prompt = rng.integers(1, scfg.vocab_size, sys_len).tolist()
+        prompts = {
+            u: sys_prompt + rng.integers(1, scfg.vocab_size, sfx_len).tolist()
+            for u in range(1, n_req + 1)
+        }
+        seng = serve_engine(telemetry)
+        sched = seng.scheduler
+        # shape REHEARSAL instead of single-request warmups: packed prefill
+        # dispatch shapes vary with the number of packed entries, so only
+        # replaying the exact arrival structure — same lengths, same Poisson
+        # tick offsets, prefix-disjoint tokens — compiles every cold/ctx
+        # pack and decode shape the measured run will produce (the rehearsal
+        # cache entries are evictable and hash-disjoint from the workload's)
+        arrival_steps = rng.poisson(2.0, n_req)
+        r_sys = rng.integers(1, scfg.vocab_size, sys_len).tolist()
+        r_prompts = {
+            u: r_sys + rng.integers(1, scfg.vocab_size, sfx_len).tolist()
+            for u in range(1, n_req + 1)
+        }
 
-    hit_rate = (seng.mgr.cached_prompt_tokens - cached0) / max(
-        1, seng.mgr.prompt_tokens_total - prompt0
+        def drive(prompt_map, uid_off):
+            arrivals = sched.tick_no + np.cumsum(arrival_steps)
+            submitted = 0
+            while submitted < n_req or not sched.idle:
+                while submitted < n_req and arrivals[submitted] <= sched.tick_no:
+                    submitted += 1
+                    sched.submit(uid_off + submitted, prompt_map[submitted],
+                                 serve_samp)
+                sched.tick()
+            return {u: sched.pop_result(uid_off + u)
+                    for u in range(1, n_req + 1)}
+
+        drive(r_prompts, 20_000)
+        # drop the rehearsal's traces/spans (compile time) from the
+        # histograms; the counters below are baselined by differencing
+        seng.telemetry.reset_window()
+        cold_tokens = seng.stats["prefill_tokens_dispatched"]
+        sched0 = dict(sched.stats)  # rehearsal ticks preempt/chunk too
+        prompt0, cached0 = seng.mgr.prompt_tokens_total, seng.mgr.cached_prompt_tokens
+
+        t0 = time.perf_counter()
+        results = drive(prompts, 0)
+        serve_dt = time.perf_counter() - t0
+        assert all(len(r) == max_new for r in results.values()), "requests failed"
+        return dict(
+            seng=seng, sched=sched, prompts=prompts, results=results,
+            serve_dt=serve_dt, cold_tokens=cold_tokens, sched0=sched0,
+            prompt0=prompt0, cached0=cached0,
+        )
+
+    # the HEADLINE tokens/s stays telemetry-free (comparable to the PR 2/4
+    # baselines); a telemetry-on twin of the identical workload supplies the
+    # percentile table and doubles as the observation-changes-nothing check
+    r = run_serve(telemetry=False)
+    seng, sched, prompts, results = r["seng"], r["sched"], r["prompts"], r["results"]
+    from deepspeed_tpu.telemetry import format_percentile_table, percentile_summary
+
+    rt = run_serve(telemetry=True)
+    twin_equal = (
+        dict(rt["seng"].stats) == dict(seng.stats)
+        and dict(rt["sched"].stats) == dict(sched.stats)
+        and rt["results"] == results
     )
-    dispatched = seng.stats["prefill_tokens_dispatched"] - cold_tokens
+    # the gate the docstring promises: observation must not change behavior
+    assert twin_equal, "telemetry-on twin diverged from the telemetry-off run"
+    rt["seng"].telemetry.flush()  # settle any deferred intermediate-chunk spans
+    pct = percentile_summary(rt["seng"].telemetry.registry, (
+        "serve/ttft_ms", "serve/tbt_ms", "serve/queue_wait_ms", "serve/e2e_ms",
+        "serve/prefill_pack_ms", "serve/decode_tick_ms",
+    ))
+    print(format_percentile_table(
+        pct, title="serve latency percentiles (telemetry twin)"))
+
+    hit_rate = (seng.mgr.cached_prompt_tokens - r["cached0"]) / max(
+        1, seng.mgr.prompt_tokens_total - r["prompt0"]
+    )
+    dispatched = seng.stats["prefill_tokens_dispatched"] - r["cold_tokens"]
     total_tokens = sum(len(p) for p in prompts.values()) + sum(
-        len(r) for r in results.values()
+        len(res) for res in results.values()
     )
     token_identical = None
     if not on_tpu:
@@ -385,7 +469,7 @@ def serving_main(quant=None, spec=False, smoke=False):
         token_identical = cold_ref.generate(prompts[3], serve_samp) == results[3]
     print(json.dumps({
         "metric": "serve_effective_tokens_per_sec_shared_prefix512",
-        "value": round(total_tokens / serve_dt, 1),
+        "value": round(total_tokens / r["serve_dt"], 1),
         "unit": "tokens/s",
         "extra": {
             "requests": n_req, "shared_prefix": sys_len, "suffix": sfx_len,
@@ -394,11 +478,15 @@ def serving_main(quant=None, spec=False, smoke=False):
             "prompt_tokens_dispatched": int(dispatched),
             "prompt_tokens_submitted": sum(len(p) for p in prompts.values()),
             "mean_queue_wait_ticks": round(
-                (sched.stats["queue_wait_ticks"] - wait0)
-                / max(1, sched.stats["finished"] - 1), 2),
-            "preemptions": sched.stats["preemptions"],
-            "prefill_chunks": sched.stats["prefill_chunks"],
+                (sched.stats["queue_wait_ticks"] - r["sched0"]["queue_wait_ticks"])
+                / max(1, sched.stats["finished"] - r["sched0"]["finished"]), 2),
+            "preemptions": sched.stats["preemptions"]
+            - r["sched0"]["preemptions"],
+            "prefill_chunks": sched.stats["prefill_chunks"]
+            - r["sched0"]["prefill_chunks"],
             "cold_vs_hit_token_identical": token_identical,
+            "latency_percentiles": pct,
+            "telemetry_disabled_twin_stats_equal": twin_equal,
         },
     }))
 
@@ -708,10 +796,11 @@ def serve8b_main(quant: str = "int8", spec: bool = False):
                        max_seq_len=128, prefill_buckets=(16, 32, 64),
                        prefill_budget=64, prefill_chunk=32)
 
-        def make_engine(speculate):
+        def make_engine(speculate, telemetry=False):
             return InferenceEngineV2(
                 params, cfg, enable_prefix_caching=True,
-                enable_speculation=speculate, spec_max_draft=4, **skw,
+                enable_speculation=speculate, spec_max_draft=4,
+                telemetry=telemetry, **skw,
             )
 
         _spec_serve_section(

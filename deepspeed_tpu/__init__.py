@@ -17,6 +17,7 @@ from .config.config import Config, ConfigError, parse_config
 from .parallel.topology import Grid, MeshSpec, initialize_mesh
 from .runtime.dataloader import DeepSpeedTpuDataLoader, RepeatingLoader
 from .runtime.engine import DeepSpeedTpuEngine, TrainState
+from .telemetry import MetricsRegistry, Telemetry  # noqa: F401
 from .utils.logging import log_dist, logger
 
 

@@ -168,6 +168,40 @@ class TrainDataConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Unified-telemetry knobs (telemetry/ — metrics registry, tick spans,
+    per-request serve traces).
+
+    ``enabled`` turns on histogram/span/trace recording; the engines'
+    ``stats`` counters count either way (they are a correctness surface).
+    ``jsonl_path`` appends structured events (per-request summaries) as one
+    JSON object per line.  ``chrome_trace_path`` writes the span + request
+    timeline as Chrome trace-event JSON on engine close/exit — load it at
+    https://ui.perfetto.dev.  ``jax_profiler`` additionally wraps train /
+    serve dispatches in ``jax.profiler.StepTraceAnnotation`` so they label
+    a live ``jax.profiler.trace`` capture.  ``exact_quantiles`` is the raw
+    sample count histograms retain before degrading to the log-bucket
+    estimate; ``max_spans`` bounds the span ring buffer."""
+
+    enabled: bool = False
+    jsonl_path: Optional[str] = None
+    chrome_trace_path: Optional[str] = None
+    jax_profiler: bool = False
+    exact_quantiles: int = 4096
+    max_spans: int = 65536
+
+    def __post_init__(self):
+        if self.exact_quantiles < 0:
+            raise ConfigError(
+                f"telemetry.exact_quantiles must be >= 0, got {self.exact_quantiles}"
+            )
+        if self.max_spans < 1:
+            raise ConfigError(
+                f"telemetry.max_spans must be >= 1, got {self.max_spans}"
+            )
+
+
+@dataclass
 class PrecisionConfig:
     enabled: bool = False
     loss_scale: float = 0.0  # 0 -> dynamic
@@ -557,6 +591,7 @@ class Config:
     aio: AIOConfig = field(default_factory=AIOConfig)
     nebula: NebulaConfig = field(default_factory=NebulaConfig)
     train_data: TrainDataConfig = field(default_factory=TrainDataConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     # --- derived (filled by finalize) ---
     dp_world_size: int = 1

@@ -57,6 +57,7 @@ class InferenceEngineV2:
         spec_max_draft: int = 4,
         spec_min_match: int = 2,
         spec_lookup_window: int = 1024,
+        telemetry=None,
     ):
         self.cfg = cfg
         # Families the paged v2 path cannot serve yet must refuse loudly
@@ -187,22 +188,51 @@ class InferenceEngineV2:
         self.mgr = StateManager(num_blocks, block_size, max_seqs,
                                 enable_prefix_caching=enable_prefix_caching)
         self._scheduler = None
-        self.stats = {
-            "prefill_tokens_dispatched": 0,  # real prompt tokens run (not pad)
-            "prefill_dispatches": 0,
-            "table_uploads": 0,  # H2D copies of the block-table mirror
-            "sampling_uploads": 0,  # H2D copies of the per-slot sampling rows
-            "decode_ticks": 0,
-            "decode_emitted": 0,  # tokens emitted by plain decode dispatches
-            "spec_ticks": 0,  # verify dispatches (each scores k+1 positions)
-            "spec_seq_forwards": 0,  # sequence-participations in verify ticks
-            "spec_drafted": 0,  # draft tokens proposed
-            "spec_accepted": 0,  # draft tokens accepted
-            "spec_emitted": 0,  # tokens emitted by verify ticks (acc + 1 each)
-            "spec_drafts_shed": 0,  # draft sets dropped by _spec_tick's own
+        # telemetry (telemetry/): ``stats`` is now a read-through view over
+        # registry counters — same keys, same read semantics, and the
+        # counters keep counting with telemetry disabled (the view is part
+        # of the engine's correctness surface).  Histograms/spans/traces are
+        # shared no-ops unless a ``telemetry`` config/True is passed.
+        from ..telemetry import StatsView, Telemetry
+
+        self.telemetry = Telemetry.ensure(telemetry)
+        if self.telemetry.enabled:
+            # serve-only processes have no train-engine atexit drain; this
+            # writes a configured chrome_trace_path/jsonl_path at exit
+            self.telemetry.register_exit_close()
+        # a SECOND engine sharing one Telemetry gets "serve2/" etc. so its
+        # stats view never aliases the first engine's counters.  The sched
+        # namespace is claimed HERE, not at first scheduler access — lazy
+        # claiming would pair serve2/ with sched/ if engine 2's scheduler
+        # happened to be touched first
+        self._ns = self.telemetry.claim_prefix("serve")
+        self._sched_ns = self.telemetry.claim_prefix("sched")
+        self._c = self.telemetry.counters(self._ns, (
+            "prefill_tokens_dispatched",  # real prompt tokens run (not pad)
+            "prefill_dispatches",
+            "table_uploads",  # H2D copies of the block-table mirror
+            "sampling_uploads",  # H2D copies of the per-slot sampling rows
+            "decode_ticks",
+            "decode_emitted",  # tokens emitted by plain decode dispatches
+            "spec_ticks",  # verify dispatches (each scores k+1 positions)
+            "spec_seq_forwards",  # sequence-participations in verify ticks
+            "spec_drafted",  # draft tokens proposed
+            "spec_accepted",  # draft tokens accepted
+            "spec_emitted",  # tokens emitted by verify ticks (acc + 1 each)
+            "spec_drafts_shed",  # draft sets dropped by _spec_tick's own
             # capacity pre-pass (direct put()/step(); scheduler sheds are
             # counted in its drafts_shed stat)
+        ))
+        self.stats = StatsView(self._c)
+        reg = self.telemetry.registry
+        self._h = {
+            k: reg.histogram(f"{self._ns}/{k}")
+            for k in ("prefill_pack_ms", "decode_tick_ms", "spec_tick_ms",
+                      "burst_tick_ms", "spec_draft_len", "spec_match_distance")
         }
+        # eagerly register this engine's request-latency group so the
+        # namespace's histograms exist (empty) before any request arrives
+        self.telemetry.request_hists(self._ns)
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
         # SplitFuse-style token budget: multiple prompts share one prefill
         # dispatch as long as their total length fits the budget (clamped to
@@ -641,23 +671,30 @@ class InferenceEngineV2:
             cur += n_pages * bs  # next prompt starts page-aligned
         self._rng, sub = jax.random.split(self._rng)
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
-        if use_ctx:
-            sampled, self.kv = self._packed_prefill_ctx_jit(
-                self.params, jnp.asarray(tokens), jnp.asarray(seg),
-                jnp.asarray(pos), jnp.asarray(pack_pages),
-                jnp.asarray(last_idx), jnp.asarray(ctx_tables),
-                jnp.asarray(ctx_lens), self.kv, sub, triple,
-            )
-        else:
-            sampled, self.kv = self._packed_prefill_jit(
-                self.params, jnp.asarray(tokens), jnp.asarray(seg),
-                jnp.asarray(pos), jnp.asarray(pack_pages),
-                jnp.asarray(last_idx), self.kv, sub, triple,
-            )
-        self.stats["prefill_tokens_dispatched"] += sum(
-            end - start for _, start, end in entries
+        n_real = sum(end - start for _, start, end in entries)
+        sp = self.telemetry.recorder.start(
+            "prefill_pack", track=self._ns, hist=self._h["prefill_pack_ms"],
+            tokens=n_real, pad=t_pad, entries=len(entries), ctx=use_ctx,
         )
-        self.stats["prefill_dispatches"] += 1
+        with self.telemetry.step_annotation(
+            "prefill_pack", self._c["prefill_dispatches"].value + 1
+        ):
+            if use_ctx:
+                sampled, self.kv = self._packed_prefill_ctx_jit(
+                    self.params, jnp.asarray(tokens), jnp.asarray(seg),
+                    jnp.asarray(pos), jnp.asarray(pack_pages),
+                    jnp.asarray(last_idx), jnp.asarray(ctx_tables),
+                    jnp.asarray(ctx_lens), self.kv, sub, triple,
+                )
+            else:
+                sampled, self.kv = self._packed_prefill_jit(
+                    self.params, jnp.asarray(tokens), jnp.asarray(seg),
+                    jnp.asarray(pos), jnp.asarray(pack_pages),
+                    jnp.asarray(last_idx), self.kv, sub, triple,
+                )
+        sp.dispatched()
+        self._c["prefill_tokens_dispatched"].inc(n_real)
+        self._c["prefill_dispatches"].inc()
         next_tokens = None
         for j, (s, start, end) in enumerate(entries):
             s.seen_tokens = end
@@ -669,6 +706,13 @@ class InferenceEngineV2:
                 self._set_block_table(s)
                 out[s.uid] = tok
             self.mgr.update_hashes(s)
+        if next_tokens is not None:
+            sp.end()  # host-complete: the sampled fetch above synced the pack
+        else:
+            # intermediate chunks only — nothing fetched, so on an async
+            # backend the pack is still in flight: defer the reading (the
+            # next host-synced tick on this track bounds and resolves it)
+            sp.end(sync_obj=sampled)
 
     def _set_block_table(self, seq) -> None:
         row = self._tables_np[seq.slot]
@@ -688,7 +732,7 @@ class InferenceEngineV2:
         if self._tables_dirty or self._tables_dev is None:
             self._tables_dev = jnp.array(self._tables_np)
             self._tables_dirty = False
-            self.stats["table_uploads"] += 1
+            self._c["table_uploads"].inc()
         return self._tables_dev
 
     def _sampling_device(self, active_seqs, sampling: SamplingParams):
@@ -709,7 +753,7 @@ class InferenceEngineV2:
                 dirty = True
         if dirty or self._samp_dev is None:
             self._samp_dev = jnp.array(self._samp_np)
-            self.stats["sampling_uploads"] += 1
+            self._c["sampling_uploads"].inc()
         return self._samp_dev
 
     # -- speculative decoding ------------------------------------------------
@@ -756,12 +800,18 @@ class InferenceEngineV2:
                 cap = min(cap, max_emit[s.uid] - 1)
             if cap <= 0:
                 continue
-            drafts = speculative.propose(
+            drafts, match_start = speculative.propose_detail(
                 s.tokens, self.spec_min_match, cap, self.spec_lookup_window
             )
             if drafts:
                 out[s.uid] = drafts
                 budget -= len(drafts)
+                self._h["spec_draft_len"].observe(len(drafts))
+                # tail -> matched-n-gram distance: ~0 = repetition loop,
+                # large = prompt-copy workload (drafter diagnostics)
+                self._h["spec_match_distance"].observe(
+                    len(s.tokens) - self.spec_min_match - match_start
+                )
         return out
 
     def _spec_tick(
@@ -798,7 +848,7 @@ class InferenceEngineV2:
                 if not n:
                     raise
                 proposals.pop(s.uid, None)
-                self.stats["spec_drafts_shed"] += 1
+                self._c["spec_drafts_shed"].inc()
                 # release the draft-tail reservation before retrying — those
                 # blocks may be exactly what the plain-decode COW clone needs
                 self.mgr.truncate_to_length(s)
@@ -834,16 +884,25 @@ class InferenceEngineV2:
                 dst_pages[row] = s.blocks[p_tok // bs]
                 dst_offs[row] = p_tok % bs
         self._rng, sub = jax.random.split(self._rng)
-        out_dev, n_out_dev, self.kv = self._spec_jit(
-            self.params, jnp.asarray(tokens), jnp.asarray(seg),
-            jnp.asarray(pos), jnp.asarray(dst_pages), jnp.asarray(dst_offs),
-            self._tables_device(), jnp.asarray(ctx_lens), jnp.asarray(draft),
-            jnp.asarray(n_draft), self._sampling_device(active_seqs, sampling),
-            self.kv, sub, sampling.top_k, sampling.temperature <= 0.0,
+        sp = self.telemetry.recorder.start(
+            "spec_tick", track=self._ns, hist=self._h["spec_tick_ms"],
+            batch=len(active_seqs), drafted=int(n_draft.sum()),
         )
-        self.stats["spec_ticks"] += 1
-        self.stats["spec_seq_forwards"] += len(active_seqs)
+        with self.telemetry.step_annotation(
+            "spec_tick", self._c["spec_ticks"].value + 1
+        ):
+            out_dev, n_out_dev, self.kv = self._spec_jit(
+                self.params, jnp.asarray(tokens), jnp.asarray(seg),
+                jnp.asarray(pos), jnp.asarray(dst_pages), jnp.asarray(dst_offs),
+                self._tables_device(), jnp.asarray(ctx_lens), jnp.asarray(draft),
+                jnp.asarray(n_draft), self._sampling_device(active_seqs, sampling),
+                self.kv, sub, sampling.top_k, sampling.temperature <= 0.0,
+            )
+        sp.dispatched()
+        self._c["spec_ticks"].inc()
+        self._c["spec_seq_forwards"].inc(len(active_seqs))
         out_np, n_out = np.asarray(out_dev), np.asarray(n_out_dev)
+        sp.end()  # the fetch above is the tick's host sync
         out: Dict[int, List[int]] = {}
         for s in active_seqs:
             n_emit = int(n_out[s.slot])
@@ -858,9 +917,9 @@ class InferenceEngineV2:
             if self.mgr.truncate_to_length(s):
                 self._set_block_table(s)
             self.mgr.update_hashes(s)
-            self.stats["spec_drafted"] += n
-            self.stats["spec_accepted"] += n_acc
-            self.stats["spec_emitted"] += n_emit
+            self._c["spec_drafted"].inc(n)
+            self._c["spec_accepted"].inc(n_acc)
+            self._c["spec_emitted"].inc(n_emit)
             s.spec_drafted += n
             s.spec_accepted += n_acc
             if n > 0:
@@ -900,14 +959,23 @@ class InferenceEngineV2:
             seq_lens[s.slot] = s.cur_len - 1  # KV position of the new token
             active[s.slot] = True
         self._rng, sub = jax.random.split(self._rng)
-        sampled, _, _, self.kv = self._decode_jit(
-            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
-            self._tables_device(), jnp.asarray(active), self.kv,
-            sub, (sampling.temperature, sampling.top_k, sampling.top_p),
+        sp = self.telemetry.recorder.start(
+            "decode_tick", track=self._ns, hist=self._h["decode_tick_ms"],
+            batch=len(active_seqs),
         )
-        self.stats["decode_ticks"] += 1
-        self.stats["decode_emitted"] += len(active_seqs)
+        with self.telemetry.step_annotation(
+            "decode_tick", self._c["decode_ticks"].value + 1
+        ):
+            sampled, _, _, self.kv = self._decode_jit(
+                self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+                self._tables_device(), jnp.asarray(active), self.kv,
+                sub, (sampling.temperature, sampling.top_k, sampling.top_p),
+            )
+        sp.dispatched()
+        self._c["decode_ticks"].inc()
+        self._c["decode_emitted"].inc(len(active_seqs))
         next_tokens = np.asarray(sampled)
+        sp.end()  # the fetch above is the tick's host sync
         out = {}
         for s in active_seqs:
             tok = int(next_tokens[s.slot])
@@ -1008,13 +1076,27 @@ class InferenceEngineV2:
         self._burst_cap = cap
         burst_dev = jnp.zeros((cap, B), jnp.int32)
         tick_dev = jnp.zeros((), jnp.int32)
-        for _ in range(n):
-            (tokens_dev, lens_dev, key_dev, self.kv, burst_dev,
-             tick_dev) = self._decode_burst_jit(
-                self.params, tokens_dev, lens_dev, tables,
-                active_j, self.kv, key_dev, burst_dev, tick_dev, triple,
-            )
+        # ONE span for the whole burst — per-tick spans would retain one
+        # device array per tick, the exact host-reference leak step_n's
+        # design removes (14 ms -> 20-70 ms ticks measured); the per-tick
+        # figure is the burst average, observed once per tick
+        sp = self.telemetry.recorder.start(
+            "decode_burst", track=self._ns, ticks=n, batch=len(active_seqs),
+        )
+        with self.telemetry.step_annotation("decode_burst", n):
+            for _ in range(n):
+                (tokens_dev, lens_dev, key_dev, self.kv, burst_dev,
+                 tick_dev) = self._decode_burst_jit(
+                    self.params, tokens_dev, lens_dev, tables,
+                    active_j, self.kv, key_dev, burst_dev, tick_dev, triple,
+                )
+        sp.dispatched()
         burst = np.asarray(burst_dev)[:n]  # [n, B] — the ONE host sync
+        sp = sp.end()
+        if sp.duration_ms is not None:
+            per_tick = sp.duration_ms / n
+            for _ in range(n):
+                self._h["burst_tick_ms"].observe(per_tick)
         out: Dict[int, int] = {}
         for s in active_seqs:
             row = [int(t) for t in burst[:, s.slot]]

@@ -42,8 +42,9 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
+from ..telemetry import NULL_REQUEST_TRACE, StatsView, Telemetry
 from .sampling import SamplingParams
 
 WAITING, PREFILL, DECODE, FINISHED = "waiting", "prefill", "decode", "finished"
@@ -63,6 +64,7 @@ class ServeRequest:
     admit_tick: int = -1  # first admission
     preemptions: int = 0
     denied_state: Optional[tuple] = None  # admission state at last failed probe
+    trace: Any = NULL_REQUEST_TRACE  # telemetry RequestTrace (no-op unless enabled)
 
 
 class ServeScheduler:
@@ -87,11 +89,22 @@ class ServeScheduler:
         self._triple = None  # shared device sampling triple
         self._uid_counter = 0
         self._spec_budget = self.prefill_chunk  # leftover chunk tokens/tick
-        self.stats = {
-            "submitted": 0, "finished": 0, "admissions": 0,
-            "preemptions": 0, "queue_wait_ticks": 0, "prefill_chunks": 0,
-            "drafts_shed": 0,  # draft sets dropped under pool pressure
-        }
+        # telemetry rides the engine's: one registry per engine+scheduler
+        # pair, ``stats`` a read-through view over "sched/*" counters (the
+        # serving counterpart of the engine's "serve/*" namespace)
+        self.telemetry: Telemetry = getattr(engine, "telemetry", None) \
+            or Telemetry.ensure(None)
+        # the engine pre-claimed the paired sched namespace at its own
+        # __init__ (sched2/ goes with serve2/ regardless of which engine's
+        # scheduler is touched first); standalone construction claims fresh
+        self._ns = getattr(engine, "_sched_ns", None) \
+            or self.telemetry.claim_prefix("sched")
+        self._c = self.telemetry.counters(self._ns, (
+            "submitted", "finished", "admissions",
+            "preemptions", "queue_wait_ticks", "prefill_chunks",
+            "drafts_shed",  # draft sets dropped under pool pressure
+        ))
+        self.stats = StatsView(self._c)
 
     # -- request intake -----------------------------------------------------
     def next_uid(self) -> int:
@@ -143,10 +156,13 @@ class ServeScheduler:
                 f"batch's {self._triple} (one static triple per dispatch)"
             )
         req = ServeRequest(uid=uid, prompt=tokens, sampling=sampling,
-                           tokens=list(tokens), submit_tick=self.tick_no)
+                           tokens=list(tokens), submit_tick=self.tick_no,
+                           trace=self.telemetry.request_trace(
+                               uid, ns=getattr(self.engine, "_ns", "serve")))
+        req.trace.submitted(prompt_tokens=len(tokens))
         self.requests[uid] = req
         self.waiting.append(req)
-        self.stats["submitted"] += 1
+        self._c["submitted"].inc()
 
     def _base_sampling(self) -> SamplingParams:
         t, k, p = self._triple
@@ -175,9 +191,10 @@ class ServeScheduler:
         req.state = PREFILL
         if req.admit_tick < 0:
             req.admit_tick = self.tick_no
-            self.stats["queue_wait_ticks"] += self.tick_no - req.submit_tick
+            self._c["queue_wait_ticks"].inc(self.tick_no - req.submit_tick)
+        req.trace.admitted()
         self._running.append(req)
-        self.stats["admissions"] += 1
+        self._c["admissions"].inc()
         return True
 
     def _admit_phase(self) -> None:
@@ -232,13 +249,23 @@ class ServeScheduler:
         self._spec_budget = max(0, budget)
         if not entries:
             return out
+        clock = self.telemetry.clock
+        t0 = clock()
         first = self.engine.prefill_entries(entries, self._base_sampling())
-        self.stats["prefill_chunks"] += len(entries)
+        t1 = clock()
+        for seq, start, end in entries:
+            r = self.requests.get(seq.uid)
+            if r is not None:
+                # chunks share the tick's pack dispatch(es); each request's
+                # chunk span carries the shared window + its own token count
+                r.trace.prefill_chunk(t0, t1, end - start)
+        self._c["prefill_chunks"].inc(len(entries))
         for req in list(self._running):
             if req.state == PREFILL and req.uid in first:
                 tok = first[req.uid]
                 req.state = DECODE
                 req.generated.append(tok)
+                req.trace.tokens(1)
                 out[req.uid] = tok
                 self._maybe_finish(req)
         return out
@@ -256,12 +283,16 @@ class ServeScheduler:
         all tokens so far — re-prefill is then mostly cache hits."""
         seq = self.engine.mgr.seqs[req.uid]
         req.tokens = list(seq.tokens)
+        # this incarnation's draft/accept totals die with the descriptor —
+        # fold them into the request trace before release
+        req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
+        req.trace.preempted()
         self.engine.mgr.release(req.uid)
         self._running.remove(req)
         req.state = WAITING
         req.preemptions += 1
         self.waiting.appendleft(req)
-        self.stats["preemptions"] += 1
+        self._c["preemptions"].inc()
 
     def _decode_phase(self, decoding: List[ServeRequest]) -> Dict[int, int]:
         out: Dict[int, int] = {}
@@ -293,7 +324,7 @@ class ServeScheduler:
                     # preempting anyone — speculation is optional, residency
                     # is not (plain decode needs only one page of growth)
                     if proposals.pop(req.uid, None):
-                        self.stats["drafts_shed"] += 1
+                        self._c["drafts_shed"].inc()
                         continue
                     victim = self._pick_victim(exclude=req)
                     if victim is None:
@@ -323,6 +354,7 @@ class ServeScheduler:
                 # sequence releases its state
                 emitted = emitted[: emitted.index(stop) + 1]
             req.generated.extend(emitted)
+            req.trace.tokens(len(emitted))
             out[req.uid] = emitted[-1]
             self._maybe_finish(req)
         return out
@@ -330,17 +362,20 @@ class ServeScheduler:
     # -- completion ---------------------------------------------------------
     def _maybe_finish(self, req: ServeRequest) -> None:
         samp = req.sampling
+        seq = self.engine.mgr.seqs[req.uid]
         done = (
             (samp.stop_token is not None
              and req.generated[-1] == samp.stop_token)
             or len(req.generated) >= samp.max_new_tokens
-            or self.engine.mgr.seqs[req.uid].cur_len >= self.engine.max_seq_len
+            or seq.cur_len >= self.engine.max_seq_len
         )
         if done:
+            req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
             self.engine.mgr.release(req.uid)
             self._running.remove(req)
             req.state = FINISHED
-            self.stats["finished"] += 1
+            self._c["finished"].inc()
+            req.trace.finished()
 
     def result(self, uid: int) -> List[int]:
         """Generated tokens with ``generate()`` semantics: trailing stop

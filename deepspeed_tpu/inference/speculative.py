@@ -29,7 +29,7 @@ cache invalidation here.
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Sequence, Tuple
 
 
 def propose(
@@ -52,9 +52,24 @@ def propose(
     per-sequence index to invalidate across preemption or uid reuse.
     Returns ``[]`` when the history is too short or no n-gram recurs.
     """
+    return propose_detail(tokens, min_match, max_draft, lookup_window)[0]
+
+
+def propose_detail(
+    tokens: Sequence[int],
+    min_match: int,
+    max_draft: int,
+    lookup_window: int = 1024,
+) -> Tuple[List[int], int]:
+    """``propose`` plus the drafter diagnostic telemetry needs:
+    ``(drafts, match_start)`` where ``match_start`` is the index of the
+    matched n-gram's first token (-1 when nothing was proposed).  The
+    tail-to-match distance ``(len(tokens) - min_match) - match_start``
+    separates the drafter's two regimes — ~0 means a local repetition
+    loop, large means a prompt-copy workload."""
     n = len(tokens)
     if max_draft <= 0 or min_match <= 0 or n < min_match + 1:
-        return []
+        return [], -1
     suffix = tuple(tokens[-min_match:])
     lo = max(0, n - lookup_window)
     # scan newest-first; the suffix itself starts at n - min_match, so the
@@ -70,5 +85,5 @@ def propose(
             while idx >= n:  # continuation runs off the end: cycle the period
                 idx -= period
             out.append(int(tokens[idx]))
-        return out
-    return []
+        return out, i
+    return [], -1

@@ -90,8 +90,16 @@ class WandbMonitor(Monitor):
     def write_events(self, events: List[Event]):
         if self._wandb is None:
             return
+        # group by step: the engine's deferred-metrics flush delivers a
+        # whole steps_per_print window at once — one wandb.log call per
+        # STEP (all of a step's labels in one dict), not one per event
+        # (each log call is a network-bound row commit; the same batching
+        # rationale as the CSV writer's one-open-per-label flush)
+        by_step: dict = {}
         for label, value, step in events:
-            self._wandb.log({label: value}, step=step)
+            by_step.setdefault(step, {})[label] = value
+        for step, row in by_step.items():
+            self._wandb.log(row, step=step)
 
 
 class CometMonitor(Monitor):

@@ -59,6 +59,7 @@ from ..utils.timer import (
 from . import precision, zero
 from .lr_schedules import LRScheduler, get_lr_schedule_fn
 from .prefetch import DevicePrefetcher, MetricsBuffer, host_scalar
+from ..telemetry import Telemetry
 
 
 def _now() -> float:
@@ -86,6 +87,12 @@ def _drain_metrics_at_exit():
         try:
             engine._flush_step_metrics()
         except Exception:  # noqa: BLE001 — backend may be torn down
+            pass
+        try:
+            # settles deferred spans and writes the Chrome trace file when
+            # telemetry.chrome_trace_path is configured
+            engine.telemetry.close()
+        except Exception:  # noqa: BLE001
             pass
 
 
@@ -166,6 +173,12 @@ class DeepSpeedTpuEngine:
             steps_per_output=config.steps_per_print,
         )
         self.monitor = None  # attached by initialize()
+        # unified telemetry (telemetry/): spans around train_batch with
+        # deferred device readings, registry snapshot fan-out to the
+        # monitor at flush boundaries; near-zero no-ops unless
+        # config.telemetry.enabled
+        self.telemetry = Telemetry(config.telemetry)
+        self._h_step = self.telemetry.registry.histogram("train/step_ms")
         self.lr_schedule_fn = self._build_lr_schedule()
         self.lr_scheduler = LRScheduler(self.lr_schedule_fn)
         self._onebit = config.optimizer.type.lower().replace("_", "") in (
@@ -998,7 +1011,16 @@ class DeepSpeedTpuEngine:
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
         rng = self._next_rng()
-        self.state, metrics = self._get_train_step(batch)(self.state, batch, rng)
+        # deferred-device-read span (the PR 1 MetricsBuffer trick): the
+        # dispatch wall time lands now, the loss reading is blocked on only
+        # at the steps_per_print flush — no per-step host sync added
+        tb_span = self.telemetry.recorder.start(
+            "train_batch", track="train", hist=self._h_step,
+            step=self.global_steps + 1,
+        )
+        with self.telemetry.step_annotation("train_batch", self.global_steps + 1):
+            self.state, metrics = self._get_train_step(batch)(self.state, batch, rng)
+        tb_span.end(sync_obj=metrics.loss)
         self._last_metrics = metrics
         self.global_steps += 1
         async_metrics = self.config.train_data.async_metrics
@@ -1332,6 +1354,9 @@ class DeepSpeedTpuEngine:
         Sync mode flushes a one-item buffer every step; async mode flushes
         a whole window at once (one deferred sync instead of one per
         step)."""
+        # deferred telemetry spans settle at the same boundary (one
+        # block_until_ready per window, same contract as the buffer below)
+        self.telemetry.flush()
         if len(self._metrics_buffer) == 0:
             return
         fp16 = self.config.fp16.enabled
@@ -1353,6 +1378,10 @@ class DeepSpeedTpuEngine:
                         ("Train/Samples/loss_scale", m.loss_scale, step),
                     ]
                 )
+        if emit and self.telemetry.enabled:
+            # registry aggregates ride the same monitor fan-out as the
+            # per-step rows — (label, value, step) is the shared shape
+            events.extend(self.telemetry.registry.snapshot(self.global_steps))
         if events:
             self.monitor.write_events(events)
 
@@ -1466,6 +1495,7 @@ class DeepSpeedTpuEngine:
             self._place_batch,
             depth=depth,
             state_fn=state_fn,
+            telemetry=self.telemetry,
         )
         self._active_prefetcher = pf
         self._prefetch_loader = data_loader

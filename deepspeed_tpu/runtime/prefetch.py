@@ -88,9 +88,22 @@ class DevicePrefetcher:
         place_fn: Callable[[Any], Any],
         depth: int = 2,
         state_fn: Optional[Callable[[], Any]] = None,
+        telemetry=None,
     ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        # pipeline-health telemetry: consumer wait on an empty queue is the
+        # input latency the prefetcher failed to hide (0 in steady state);
+        # queue depth gauges how much headroom double-buffering has left.
+        # All no-ops unless an enabled Telemetry is passed.
+        from ..telemetry import Telemetry
+
+        tel = Telemetry.ensure(telemetry)
+        self._tel_enabled = tel.enabled
+        self._clock = tel.clock
+        self._c_batches = tel.registry.counter("input/batches_prefetched")
+        self._h_wait = tel.registry.histogram("input/consumer_wait_ms")
+        self._g_depth = tel.registry.gauge("input/queue_depth")
         self._it = iter(iterator)
         self._place = place_fn
         self._state_fn = state_fn
@@ -156,6 +169,7 @@ class DevicePrefetcher:
     def __next__(self):
         if self._closed:
             raise PrefetchStopped("prefetcher is closed")
+        t_wait = self._clock() if self._tel_enabled else 0.0
         while True:
             try:
                 kind, payload = self._queue.get(timeout=0.05)
@@ -165,12 +179,16 @@ class DevicePrefetcher:
                     # worker died without posting a terminal item (should
                     # not happen; defensive against hard thread kills)
                     raise StopIteration
+        if self._tel_enabled:
+            self._h_wait.observe((self._clock() - t_wait) * 1e3)
+            self._g_depth.set(self._queue.qsize())
         if kind == _END:
             raise StopIteration
         if kind == _ERR:
             raise payload
         with self._lock:
             self._pending_states.popleft()
+        self._c_batches.inc()
         return payload
 
     def qsize(self) -> int:

@@ -1,0 +1,29 @@
+"""Unified telemetry: metrics registry, tick spans, per-request traces.
+
+- ``registry.py`` — thread-safe counters/gauges/histograms (log-spaced
+  buckets + exact small-count quantiles), ``snapshot()`` ->
+  ``(label, value, step)`` events for the monitor fan-out, JSONL sink,
+  ``StatsView`` compat mapping backing the engines' ``stats`` dicts.
+- ``tracing.py`` — ``TraceRecorder`` dispatch spans with deferred device
+  readings, ``RequestTrace`` serve-request lifecycles (TTFT / TBT / queue
+  wait / accept rate), Chrome trace-event export (Perfetto-loadable),
+  ``Telemetry`` facade with the optional ``jax.profiler`` step-annotation
+  hook.
+"""
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    StatsView,
+    format_percentile_table,
+    percentile_summary,
+)
+from .tracing import (  # noqa: F401
+    NULL_REQUEST_TRACE,
+    NULL_SPAN,
+    RequestTrace,
+    Span,
+    Telemetry,
+    TraceRecorder,
+)

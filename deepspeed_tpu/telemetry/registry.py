@@ -1,0 +1,440 @@
+"""Metrics registry: thread-safe counters / gauges / histograms.
+
+The aggregate half of the unified telemetry layer (tracing.py is the
+timeline half).  Reference analogues: ``deepspeed/monitor`` consumes
+``(label, value, step)`` events and ``deepspeed/utils/timer.py`` keeps
+named aggregates; this registry is the single process-wide home for both
+shapes, feeding
+
+- the serving/training hot paths (engine_v2 / ServeScheduler ``stats``
+  dicts are :class:`StatsView` read-throughs over registry counters),
+- the monitor fan-out (``snapshot()`` flattens every metric to the
+  ``(label, value, step)`` triples ``MonitorMaster.write_events`` takes),
+- a JSONL structured-event sink for per-request records and ad-hoc events.
+
+Design constraints, in order:
+
+1. **Counters are always live.**  The engines' ``stats`` compat views are
+   part of their correctness surface (tests and bench diff them), so a
+   counter counts whether telemetry is enabled or not — its cost is one
+   lock acquire + integer add.  The *observability* machinery (histograms,
+   gauges, snapshot export, the JSONL sink, span/trace recording) is what
+   the disabled path turns into shared no-op singletons.
+2. **Histograms are fixed log-spaced buckets + exact small-count
+   quantiles.**  Latency distributions span decades (µs dispatch to
+   seconds of queueing); log buckets bound relative quantile error at
+   ``sqrt(growth)`` regardless of scale.  Until ``exact_limit``
+   observations, raw samples are retained and quantiles are exact
+   (nearest-rank) — a serve run of a few thousand requests reports exact
+   p99s, while an unbounded production stream degrades gracefully to the
+   bucket estimate instead of growing host memory.
+3. **Thread safety** is per-metric locking: the serving loop, the prefetch
+   worker, and checkpoint threads all observe concurrently.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, Iterator, List, MutableMapping, Optional, Sequence, Tuple
+
+Event = Tuple[str, float, int]
+
+
+class Counter:
+    """Thread-safe integer counter (float increments are accepted)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    add = inc
+
+    def set(self, v) -> None:
+        """Direct write — exists for the ``StatsView`` compat path, where
+        legacy ``stats[k] = v`` assignments must keep working."""
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self):
+        return self._value
+
+    def __repr__(self) -> str:
+        return f"Counter({self.name}={self._value})"
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depths, pool occupancy)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def __repr__(self) -> str:
+        return f"Gauge({self.name}={self._value})"
+
+
+class Histogram:
+    """Log-spaced-bucket histogram with exact quantiles for small counts.
+
+    Buckets cover ``(lo * growth**(i-1), lo * growth**i]``; bucket 0 is the
+    underflow bin (values <= ``lo``, including 0 — accept-rate style [0, 1]
+    metrics stay exact while raw samples are retained) and the last bucket
+    is the overflow bin.  Quantiles are nearest-rank over raw samples up to
+    ``exact_limit`` observations; past that the raw list is dropped and
+    quantiles interpolate the geometric midpoint of the covering bucket,
+    clamped to the observed [min, max].
+    """
+
+    __slots__ = ("name", "_lock", "_lo", "_log_lo", "_log_g", "_growth",
+                 "_counts", "_samples", "_sorted", "count", "sum",
+                 "_min", "_max", "exact_limit")
+
+    def __init__(self, name: str, lo: float = 1e-3, hi: float = 1e7,
+                 growth: float = 2.0 ** 0.25, exact_limit: int = 4096):
+        if not (lo > 0 and hi > lo and growth > 1):
+            raise ValueError(f"bad histogram bounds lo={lo} hi={hi} growth={growth}")
+        self.name = name
+        self._lock = threading.Lock()
+        self._lo = lo
+        self._growth = growth
+        self._log_lo = math.log(lo)
+        self._log_g = math.log(growth)
+        n_buckets = int(math.ceil((math.log(hi) - self._log_lo) / self._log_g)) + 2
+        self._counts = [0] * n_buckets
+        self._samples: Optional[List[float]] = []
+        self._sorted: Optional[List[float]] = None
+        self.count = 0
+        self.sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self.exact_limit = exact_limit
+
+    def _bucket_of(self, v: float) -> int:
+        if v <= self._lo:
+            return 0
+        idx = 1 + int((math.log(v) - self._log_lo) / self._log_g)
+        return min(idx, len(self._counts) - 1)
+
+    def _edge(self, i: int) -> float:
+        return self._lo * self._growth ** i
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            self._counts[self._bucket_of(v)] += 1
+            if self._samples is not None:
+                self._samples.append(v)
+                self._sorted = None
+                if len(self._samples) > self.exact_limit:
+                    self._samples = None  # degrade to the bucket estimate
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self.count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self.count else 0.0
+
+    @property
+    def exact(self) -> bool:
+        """True while quantiles are computed from retained raw samples."""
+        return self._samples is not None
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile, ``q`` in [0, 100]."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            rank = min(self.count, max(1, math.ceil(q / 100.0 * self.count)))
+            if self._samples is not None:
+                if self._sorted is None:
+                    self._sorted = sorted(self._samples)
+                return self._sorted[rank - 1]
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= rank:
+                    if i == 0:
+                        est = self._lo
+                    else:
+                        est = math.sqrt(self._edge(i - 1) * self._edge(i))
+                    return min(max(est, self._min), self._max)
+            return self._max  # unreachable; defensive
+
+    def quantiles(self, qs: Sequence[float] = (50, 90, 99)) -> Dict[str, float]:
+        return {f"p{int(q) if float(q).is_integer() else q}": self.percentile(q)
+                for q in qs}
+
+    def reset(self) -> None:
+        """Drop every observation (bench: discard the warmup/compile window
+        so percentiles describe only the measured run)."""
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._samples = []
+            self._sorted = None
+            self.count = 0
+            self.sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
+
+    def __repr__(self) -> str:
+        return (f"Histogram({self.name}: n={self.count} mean={self.mean:.4g} "
+                f"p50={self.percentile(50):.4g})")
+
+
+class _NullCounter:
+    __slots__ = ()
+    name = "null"
+    value = 0
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    add = inc
+
+    def set(self, v) -> None:
+        pass
+
+
+class _NullGauge:
+    __slots__ = ()
+    name = "null"
+    value = 0.0
+
+    def set(self, v) -> None:
+        pass
+
+
+class _NullHistogram:
+    __slots__ = ()
+    name = "null"
+    count = 0
+    sum = 0.0
+    mean = 0.0
+    min = 0.0
+    max = 0.0
+    exact = True
+
+    def observe(self, v) -> None:
+        pass
+
+    def percentile(self, q: float) -> float:
+        return 0.0
+
+    def quantiles(self, qs: Sequence[float] = (50, 90, 99)) -> Dict[str, float]:
+        return {f"p{int(q) if float(q).is_integer() else q}": 0.0 for q in qs}
+
+    def reset(self) -> None:
+        pass
+
+
+NULL_COUNTER = _NullCounter()
+NULL_GAUGE = _NullGauge()
+NULL_HISTOGRAM = _NullHistogram()
+
+
+class MetricsRegistry:
+    """Named metrics + structured-event sink.
+
+    ``enabled=False`` is the near-zero-cost path: ``gauge()``/``histogram()``
+    hand back shared no-op singletons, ``snapshot()`` is empty and
+    ``event()`` returns immediately.  ``counter()`` always returns a live
+    counter — see the module docstring for why.
+    """
+
+    def __init__(self, enabled: bool = True, jsonl_path: Optional[str] = None,
+                 exact_limit: int = 4096, time_fn=time.time):
+        self.enabled = bool(enabled)
+        self.jsonl_path = jsonl_path if self.enabled else None
+        self.exact_limit = exact_limit
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._jsonl = None
+
+    # -- metric handles -----------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str):
+        if not self.enabled:
+            return NULL_GAUGE
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str, **kw):
+        if not self.enabled:
+            return NULL_HISTOGRAM
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                kw.setdefault("exact_limit", self.exact_limit)
+                h = self._histograms[name] = Histogram(name, **kw)
+            return h
+
+    def get(self, name: str):
+        """Existing metric by name (any kind), or None."""
+        with self._lock:
+            return (self._counters.get(name) or self._gauges.get(name)
+                    or self._histograms.get(name))
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self, step: int = 0) -> List[Event]:
+        """Flatten every metric to ``(label, value, step)`` events — the
+        exact shape ``MonitorMaster.write_events`` consumes.  Histograms
+        export count/mean/p50/p90/p99 sub-labels.  Empty when disabled."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        events: List[Event] = []
+        for name, c in sorted(counters):
+            events.append((name, float(c.value), step))
+        for name, g in sorted(gauges):
+            events.append((name, g.value, step))
+        for name, h in sorted(hists):
+            if h.count == 0:
+                continue
+            events.append((f"{name}/count", float(h.count), step))
+            events.append((f"{name}/mean", h.mean, step))
+            for q in (50, 90, 99):
+                events.append((f"{name}/p{q}", h.percentile(q), step))
+        return events
+
+    def reset_histograms(self) -> None:
+        """Drop every histogram's observations (counters/gauges keep their
+        values — they are baselined by differencing, not by windowing)."""
+        with self._lock:
+            hists = list(self._histograms.values())
+        for h in hists:
+            h.reset()
+
+    def event(self, name: str, **fields) -> None:
+        """Append one structured event to the JSONL sink (no-op when
+        disabled or no ``jsonl_path`` was configured)."""
+        if not self.enabled or self.jsonl_path is None:
+            return
+        rec = {"ts": self._time(), "event": name}
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._jsonl is None:
+                self._jsonl = open(self.jsonl_path, "a", buffering=1)
+            self._jsonl.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+                self._jsonl = None
+
+
+class StatsView(MutableMapping):
+    """Dict-shaped read-through view over ``{key: Counter}``.
+
+    The engines' legacy ``stats`` dicts become this view after the counter
+    migration: reads return the live counter values, writes set them
+    (supporting external ``stats[k] += n`` compat), iteration preserves the
+    registration order so ``dict(stats)`` looks exactly like the old dict.
+    """
+
+    __slots__ = ("_c",)
+
+    def __init__(self, counters: Dict[str, Counter]):
+        self._c = counters
+
+    def __getitem__(self, key: str):
+        return self._c[key].value
+
+    def __setitem__(self, key: str, value) -> None:
+        self._c[key].set(value)
+
+    def __delitem__(self, key: str) -> None:
+        raise TypeError("stats keys are fixed; counters cannot be deleted")
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._c)
+
+    def __len__(self) -> int:
+        return len(self._c)
+
+    def __repr__(self) -> str:
+        return repr(dict(self))
+
+
+def percentile_summary(
+    registry: MetricsRegistry,
+    names: Sequence[str],
+    qs: Sequence[float] = (50, 90, 99),
+) -> Dict[str, Dict[str, float]]:
+    """{short_label: {count, mean, p50, ...}} for the histograms in
+    ``names`` that exist and have observations (absent/empty ones are
+    skipped, so a speculation-off run simply has no accept-rate row)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for name in names:
+        h = registry.get(name)
+        if h is None or not isinstance(h, Histogram) or h.count == 0:
+            continue
+        row = {"count": float(h.count), "mean": h.mean}
+        row.update(h.quantiles(qs))
+        out[name.rsplit("/", 1)[-1]] = row
+    return out
+
+
+def format_percentile_table(
+    summary: Dict[str, Dict[str, float]], title: str = "latency percentiles"
+) -> str:
+    """Fixed-width text table of a ``percentile_summary`` result."""
+    if not summary:
+        return f"{title}: (no observations)"
+    qcols = [k for k in next(iter(summary.values())) if k.startswith("p")]
+    cols = ["count", "mean"] + qcols
+    width = max(len(k) for k in summary) + 2
+    lines = [title, "  " + "metric".ljust(width) + "".join(c.rjust(10) for c in cols)]
+    for label, row in summary.items():
+        cells = "".join(f"{row[c]:10.2f}" for c in cols)
+        lines.append("  " + label.ljust(width) + cells)
+    return "\n".join(lines)

@@ -28,6 +28,7 @@ class _Timer:
         self.started = False
         self._start = 0.0
         self._elapsed = 0.0  # seconds
+        self._last = 0.0
         self._count = 0
 
     def start(self, sync_obj=None):
@@ -51,12 +52,13 @@ class _Timer:
         self.started = False
 
     def last(self) -> float:
-        """Most recent recorded duration in seconds (0 if none)."""
-        return getattr(self, "_last", 0.0)
+        """Most recent recorded duration in seconds (0 since last reset)."""
+        return self._last
 
     def reset(self):
         self.started = False
         self._elapsed = 0.0
+        self._last = 0.0  # a stale _last would leak pre-reset durations
         self._count = 0
 
     def elapsed(self, reset: bool = True) -> float:

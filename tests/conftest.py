@@ -20,6 +20,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# Marker-hygiene audit, filled during collection (BEFORE the -m filter
+# deselects anything, which is why the hook below can see perf/nightly
+# items even in a `-m 'not slow'` run).  tests/test_telemetry.py asserts
+# `ran` and an empty `violations` — the regression guard for the superset
+# rule that keeps the tier-1 verify lane under its timeout.
+MARKER_AUDIT = {"ran": False, "checked": 0, "violations": []}
+
+
 def pytest_collection_modifyitems(config, items):
     """``slow`` is the SUPERSET heaviness marker: every ``nightly``/``perf``
     test is implicitly slow too, so a single ``-m 'not slow'`` expression
@@ -29,9 +37,21 @@ def pytest_collection_modifyitems(config, items):
     how the tier-1 lane silently grew past its timeout (VERDICT r5 weak
     #7's creep curve).  Individually heavy default-lane tests carry an
     explicit ``@pytest.mark.slow`` (budget table in README Testing)."""
-    for item in items:
-        if item.get_closest_marker("nightly") or item.get_closest_marker("perf"):
-            item.add_marker(pytest.mark.slow)
+    heavy = [item for item in items
+             if item.get_closest_marker("nightly") or item.get_closest_marker("perf")]
+    for item in heavy:
+        item.add_marker(pytest.mark.slow)
+    if config is None:  # unit-test invocation with fake items: skip the audit
+        return
+    # The audit re-reads the marker state AFTER the add loop, from the ONE
+    # shared `heavy` selection: if the add_marker step is ever deleted or
+    # broken, every implicitly-marked perf/nightly test lands in
+    # `violations` and the tier-1 guard test fails.
+    MARKER_AUDIT["ran"] = True
+    for item in heavy:
+        MARKER_AUDIT["checked"] += 1
+        if not item.get_closest_marker("slow"):
+            MARKER_AUDIT["violations"].append(item.nodeid)
 
 
 @pytest.fixture(scope="session")

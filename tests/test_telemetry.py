@@ -1,0 +1,536 @@
+"""Unified telemetry: registry quantiles, disabled-path no-ops, request
+lifecycle traces (TTFT/TBT/queue wait incl. preemption), Chrome trace-event
+schema + per-track ordering, stats-compat read-through views vs registry
+counters on a randomized serve run, telemetry-disabled twin equality, the
+train-engine span/snapshot wiring, monitor-writer coverage (CSV append
+semantics, Comet throttling, wandb step-grouped logging), the timer
+``reset``/``last`` regression, and the tier-1 marker-hygiene audit."""
+import json
+import sys
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import InferenceEngineV2, SamplingParams
+from deepspeed_tpu.models import get_preset
+from deepspeed_tpu.models.transformer import init_params
+from deepspeed_tpu.telemetry import (
+    Histogram,
+    MetricsRegistry,
+    StatsView,
+    Telemetry,
+    format_percentile_table,
+    percentile_summary,
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    # fp32 so greedy twin runs cannot diverge on bf16 near-ties
+    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _serve_once(cfg, params, telemetry):
+    """Overloaded randomized serve run (pool pressure -> preemption) with
+    speculation + prefix caching live, deterministic across calls."""
+    eng = InferenceEngineV2(
+        params, cfg, max_seqs=3, num_blocks=8, block_size=8,
+        prefill_buckets=(16, 32), enable_prefix_caching=True,
+        enable_speculation=True, spec_max_draft=4, telemetry=telemetry,
+    )
+    sched = eng.scheduler
+    rng = np.random.default_rng(1)
+    # random base + repeated tail so the prompt-lookup drafter fires
+    prompts = {
+        u: [int(t) for t in rng.integers(1, 255, 10)] + [7, 8] * 2
+        for u in range(1, 5)
+    }
+    samp = SamplingParams(temperature=0.0, max_new_tokens=24)
+    for u, p in prompts.items():
+        sched.submit(u, p, samp)
+    res = sched.run()
+    assert all(len(res[u]) == 24 for u in prompts)
+    eng.mgr.allocator.audit()
+    return eng, sched, res
+
+
+@pytest.fixture(scope="module")
+def serve_pair(tiny):
+    """The same workload twice: telemetry on (inspected) and off (twin)."""
+    cfg, params = tiny
+    on = _serve_once(cfg, params, telemetry=True)
+    off = _serve_once(cfg, params, telemetry=False)
+    return on, off
+
+
+# ---------------------------------------------------------------------------
+# registry: counters, histograms, quantiles, disabled path, stats views
+# ---------------------------------------------------------------------------
+def test_counter_thread_safe_and_snapshot():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("x/hits")
+    threads = [threading.Thread(target=lambda: [c.inc() for _ in range(5000)])
+               for _ in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert c.value == 20000
+    assert reg.counter("x/hits") is c  # get-or-create returns the same object
+    assert ("x/hits", 20000.0, 7) in reg.snapshot(step=7)
+
+
+def test_histogram_exact_quantiles_small_count():
+    h = Histogram("h", exact_limit=4096)
+    vals = list(range(1, 101))  # 1..100
+    np.random.default_rng(0).shuffle(vals)
+    for v in vals:
+        h.observe(v)
+    assert h.exact
+    # nearest-rank: p50 of 1..100 = 50, p90 = 90, p99 = 99, p100 = max
+    assert h.percentile(50) == 50
+    assert h.percentile(90) == 90
+    assert h.percentile(99) == 99
+    assert h.percentile(100) == 100
+    assert h.min == 1 and h.max == 100 and h.count == 100
+    assert h.mean == pytest.approx(50.5)
+
+
+def test_histogram_bucketed_quantiles_bounded_error():
+    """Past exact_limit the raw samples drop and quantiles come from the
+    log-spaced buckets: relative error is bounded by sqrt(growth)."""
+    h = Histogram("h", exact_limit=16, growth=2 ** 0.25)
+    rng = np.random.default_rng(0)
+    vals = np.exp(rng.normal(3.0, 1.0, 2000))  # lognormal, decades of spread
+    for v in vals:
+        h.observe(v)
+    assert not h.exact
+    bound = (2 ** 0.25) ** 0.5 + 0.02
+    for q in (50, 90, 99):
+        est, true = h.percentile(q), float(np.percentile(vals, q))
+        assert 1 / bound <= est / true <= bound, (q, est, true)
+    # min/max clamp the tails exactly
+    assert h.percentile(0) >= h.min and h.percentile(100) <= h.max
+
+
+def test_disabled_registry_is_noop_but_counters_count():
+    reg = MetricsRegistry(enabled=False, jsonl_path="/nonexistent/dir/x.jsonl")
+    h = reg.histogram("a")
+    g = reg.gauge("b")
+    assert h is reg.histogram("zzz")  # shared null singleton
+    h.observe(1.0)
+    g.set(5)
+    assert h.count == 0 and h.percentile(99) == 0.0 and g.value == 0.0
+    reg.event("boom", x=1)  # no sink touched (the path is unwritable)
+    assert reg.snapshot() == []
+    # counters are the stats contract: they count regardless
+    c = reg.counter("serve/ticks")
+    c.inc(3)
+    assert c.value == 3
+
+    tel = Telemetry(None)
+    assert not tel.enabled
+    span = tel.recorder.start("x", track="t")
+    assert span.end() is span and len(tel.recorder) == 0
+    tr = tel.request_trace(1)
+    tr.submitted(); tr.admitted(); tr.tokens(1); tr.finished()
+    assert tel.h_ttft.count == 0
+    assert tel.chrome_trace()["traceEvents"] == []
+
+
+def test_histogram_reset_and_window():
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("w")
+    for v in (1.0, 10.0, 100.0):
+        h.observe(v)
+    c = reg.counter("kept")
+    c.inc(5)
+    reg.reset_histograms()
+    assert h.count == 0 and h.percentile(99) == 0.0 and h.min == 0.0
+    assert c.value == 5  # counters are baselined by differencing, not reset
+    h.observe(7.0)  # still functional after reset
+    assert h.count == 1 and h.percentile(50) == 7.0
+
+    tel = Telemetry(True)
+    tel.h_ttft.observe(3.0)
+    tel.reset_window()
+    assert tel.h_ttft.count == 0
+    Telemetry(None).reset_window()  # disabled path: no-op, no error
+
+
+def test_claim_prefix_second_engine_does_not_alias(tiny):
+    """Two engines sharing one Telemetry must keep independent stats —
+    the second claimant gets the serve2/sched2 namespaces."""
+    tel = Telemetry(True)
+    assert tel.claim_prefix("x") == "x"
+    assert tel.claim_prefix("x") == "x2"
+    assert tel.claim_prefix("x") == "x3"
+
+    cfg, params = tiny
+    kw = dict(max_seqs=2, num_blocks=8, block_size=8, prefill_buckets=(16, 32))
+    e1 = InferenceEngineV2(params, cfg, telemetry=tel, **kw)
+    e2 = InferenceEngineV2(params, cfg, telemetry=tel, **kw)
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    e1.scheduler.submit(1, list(range(1, 13)), samp)
+    e1.scheduler.run()
+    assert e1.stats["decode_ticks"] > 0
+    assert e2.stats["decode_ticks"] == 0  # no aliasing through the registry
+    assert dict(e2.scheduler.stats)["submitted"] == 0
+    assert tel.registry.get("serve2/decode_ticks").value == 0
+    assert e1.telemetry is e2.telemetry  # still one shared trace timeline
+    # request-latency histograms are namespaced too, not just counters
+    assert tel.registry.get("serve/ttft_ms").count == 1
+    assert tel.registry.get("serve2/ttft_ms").count == 0
+    e2.scheduler.submit(2, list(range(1, 13)), samp)
+    e2.scheduler.run()
+    assert tel.registry.get("serve2/ttft_ms").count == 1
+    assert tel.registry.get("serve/ttft_ms").count == 1  # unchanged
+
+
+def test_chunked_prefill_spans_defer_and_resolve_tick_tight(tiny):
+    """An intermediate prefill chunk completes no prompt, so nothing is
+    fetched host-side: its span takes the deferred (sync_obj) path, and the
+    NEXT host-complete span on the track resolves it with a tick-tight
+    window — NOT the end-of-run flush (which would smear the whole run
+    across it)."""
+    cfg, params = tiny
+    eng = InferenceEngineV2(
+        params, cfg, max_seqs=2, num_blocks=16, block_size=8,
+        prefill_buckets=(8, 16, 32), prefill_chunk=8, telemetry=True,
+    )
+    sched = eng.scheduler
+    sched.submit(1, list(range(1, 21)), SamplingParams(
+        temperature=0.0, max_new_tokens=4))
+    sched.run()
+    assert eng.stats["prefill_dispatches"] >= 2  # 20 tokens / 8-chunk
+    # all packs already observed, WITHOUT any explicit flush: the later
+    # host-synced ticks bounded the deferred chunks as the run progressed
+    h = eng.telemetry.registry.get("serve/prefill_pack_ms")
+    assert h.count == eng.stats["prefill_dispatches"]
+    # tick-tight: a deferred chunk's window is bounded by its neighboring
+    # ticks, nowhere near the full run's duration
+    run_ms = sum(t.e2e_ms for t in eng.telemetry.finished_traces)
+    assert h.max < max(run_ms / 2, 1.0), (h.max, run_ms)
+    evs = eng.telemetry.chrome_trace()["traceEvents"]
+    # the deferred chunk resolved into a serve-device window event
+    assert any(e["ph"] == "X" and "window" in e["name"] for e in evs)
+
+
+def test_stats_view_mapping_semantics():
+    reg = MetricsRegistry(enabled=True)
+    c = {k: reg.counter(f"p/{k}") for k in ("a", "b")}
+    view = StatsView(c)
+    c["a"].inc(2)
+    assert view["a"] == 2 and view["b"] == 0
+    assert dict(view) == {"a": 2, "b": 0}
+    assert list(view) == ["a", "b"] and len(view) == 2
+    view["b"] += 5  # legacy external write path
+    assert c["b"].value == 5
+    with pytest.raises(TypeError):
+        del view["a"]
+
+
+# ---------------------------------------------------------------------------
+# request trace lifecycle (fake clock): submit -> preempt -> finish
+# ---------------------------------------------------------------------------
+def test_request_trace_lifecycle(tmp_path):
+    clk = _Clock()
+    tel = Telemetry(True, jsonl_path=str(tmp_path / "events.jsonl"), clock=clk)
+    tr = tel.request_trace(42)
+    clk.t = 1.0
+    tr.submitted(prompt_tokens=10)
+    clk.t = 1.5
+    tr.admitted()
+    tr.prefill_chunk(1.5, 2.0, 8)
+    clk.t = 2.5
+    tr.tokens(1)  # first token
+    clk.t = 3.0
+    tr.preempted()
+    clk.t = 3.5
+    tr.admitted()  # re-admission: no second queue-wait observation
+    clk.t = 4.0
+    tr.tokens(2)  # spec tick: 2 tokens share the 1.5 s gap
+    tr.add_spec(4, 2)
+    clk.t = 5.0
+    tr.finished()
+
+    assert tr.queue_wait_ms == pytest.approx(500.0)
+    assert tr.ttft_ms == pytest.approx(1500.0)
+    assert tr.e2e_ms == pytest.approx(4000.0)
+    assert tr.preemptions == 1 and tr.readmits == 1
+    assert tr.tokens_emitted == 3 and tr.accept_rate == 0.5
+    assert tr.tbt_gaps_ms == pytest.approx([750.0, 750.0])
+    # histograms observed at the moment each quantity became known
+    assert tel.h_queue_wait.count == 1
+    assert tel.h_queue_wait.percentile(50) == pytest.approx(500.0)
+    assert tel.h_ttft.count == 1
+    assert tel.h_ttft.percentile(50) == pytest.approx(1500.0)
+    assert tel.h_tbt.count == 2
+    assert tel.h_e2e.percentile(50) == pytest.approx(4000.0)
+    assert tel.h_accept.percentile(50) == pytest.approx(0.5)
+    assert tel.finished_traces == [tr]
+    # the finish wrote a structured JSONL event
+    tel.close()
+    lines = [json.loads(line) for line in
+             (tmp_path / "events.jsonl").read_text().splitlines()]
+    ev = next(rec for rec in lines if rec["event"] == "request_finished")
+    assert ev["uid"] == 42 and ev["preemptions"] == 1
+    assert ev["accept_rate"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export: schema validity + strict per-track ordering
+# ---------------------------------------------------------------------------
+def test_chrome_trace_schema_and_ordering():
+    tel = Telemetry(True)
+    rec = tel.recorder
+    for i in range(3):
+        rec.start("tick", track="serve", i=i).end()
+    # deferred device reading: ends with a sync object, resolves at flush
+    x = jnp.zeros((4,))
+    rec.start("train_batch", track="train").end(sync_obj=x)
+    rec.start("train_batch", track="train").end(sync_obj=x)
+    tr = tel.request_trace(3)
+    tr.submitted(prompt_tokens=4)
+    tr.admitted()
+    tr.tokens(1)
+    tr.tokens(1)
+    tr.finished()
+
+    out = tel.chrome_trace()
+    json.loads(json.dumps(out))  # round-trips as plain JSON
+    evs = out["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs, "no complete events exported"
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    # strictly increasing ts per (pid, tid)
+    by_track = {}
+    for e in xs:
+        by_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    for key, ts in by_track.items():
+        assert all(b > a for a, b in zip(ts, ts[1:])), key
+    # the deferred train spans resolved and produced a device-window event
+    names = {e["name"] for e in xs}
+    assert any("window" in n for n in names)
+    track_names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert {"serve", "train", "train-device"} <= track_names
+    assert any(e["pid"] == 1 and e["name"] == "queued" for e in xs)
+
+
+# ---------------------------------------------------------------------------
+# serve integration: compat views, traces under preemption, disabled twin
+# ---------------------------------------------------------------------------
+def test_stats_views_stay_equal_to_registry_counters(serve_pair):
+    (eng, sched, _), _ = serve_pair
+    reg = eng.telemetry.registry
+    assert sched.telemetry is eng.telemetry  # one registry per pair
+    for k, v in eng.stats.items():
+        assert reg.get(f"serve/{k}").value == v, k
+    for k, v in sched.stats.items():
+        assert reg.get(f"sched/{k}").value == v, k
+    # and the monitor-facing snapshot carries the same values
+    snap = dict((label, val) for label, val, _ in reg.snapshot(step=1))
+    assert snap["serve/decode_ticks"] == eng.stats["decode_ticks"]
+    assert snap["sched/finished"] == sched.stats["finished"]
+    assert eng.stats["spec_drafted"] > 0  # speculation was actually live
+
+
+def test_request_traces_under_preemption(serve_pair):
+    (eng, sched, _), _ = serve_pair
+    tel = eng.telemetry
+    assert sched.stats["preemptions"] >= 1  # pool pressure was real
+    traces = tel.finished_traces
+    assert len(traces) == 4
+    assert sum(t.preemptions for t in traces) == sched.stats["preemptions"]
+    assert tel.h_ttft.count == 4 and tel.h_queue_wait.count == 4
+    assert tel.h_tbt.count > 0 and tel.h_e2e.count == 4
+    for t in traces:
+        assert t.tokens_emitted >= 24  # stop-trimmed tails may add a few
+        assert t.e2e_ms >= t.ttft_ms >= t.queue_wait_ms >= 0
+    for h in (tel.h_ttft, tel.h_tbt, tel.h_queue_wait, tel.h_e2e):
+        assert h.percentile(50) <= h.percentile(90) <= h.percentile(99)
+    # per-request accept rate folded across preemption incarnations
+    drafted = sum(t.drafted for t in traces)
+    accepted = sum(t.accepted for t in traces)
+    assert drafted == eng.stats["spec_drafted"]
+    assert accepted == eng.stats["spec_accepted"]
+    # tick spans recorded + percentile table renders
+    assert len(tel.recorder) > 0
+    table = format_percentile_table(percentile_summary(
+        tel.registry, ("serve/ttft_ms", "serve/tbt_ms", "serve/queue_wait_ms")))
+    assert "ttft_ms" in table and "p99" in table
+    # request tracks appear in the chrome export
+    evs = tel.chrome_trace()["traceEvents"]
+    assert any(e["ph"] == "X" and e["pid"] == 1 and e["name"] == "preempted"
+               for e in evs)
+
+
+def test_telemetry_disabled_twin_has_identical_stats(serve_pair):
+    (eng_on, sched_on, res_on), (eng_off, sched_off, res_off) = serve_pair
+    assert res_on == res_off  # observation does not change behavior
+    assert dict(eng_on.stats) == dict(eng_off.stats)
+    assert dict(sched_on.stats) == dict(sched_off.stats)
+    # and the disabled engine recorded nothing
+    assert len(eng_off.telemetry.recorder) == 0
+    assert eng_off.telemetry.finished_traces == []
+    assert eng_off.telemetry.registry.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# train engine wiring: spans, deferred flush, registry -> monitor fan-out
+# ---------------------------------------------------------------------------
+def test_train_engine_telemetry_spans_and_monitor_fanout():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import CausalLM
+
+    cfg = get_preset("tiny", max_seq_len=32)
+    engine, _, _, _ = ds.initialize(
+        model=CausalLM(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 0},
+            "bf16": {"enabled": True},
+            "steps_per_print": 2,
+            "telemetry": {"enabled": True},
+        },
+    )
+    captured = []
+    engine.monitor = types.SimpleNamespace(
+        enabled=True, write_events=captured.extend
+    )
+    rng = np.random.default_rng(0)
+    # global batch = micro(1) x dp(8 virtual devices)
+    dp = engine.config.dp_world_size
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (dp, 33), dtype=np.int64)}
+    for _ in range(4):
+        engine.train_batch(batch)
+    engine.get_last_loss()
+    assert len(engine.telemetry.recorder) == 4  # one span per step
+    h = engine.telemetry.registry.get("train/step_ms")
+    assert h.count == 4 and h.percentile(50) > 0
+    labels = {label for label, _, _ in captured}
+    assert "Train/Samples/train_loss" in labels  # legacy rows intact
+    assert "train/step_ms/p50" in labels  # registry snapshot rode along
+
+
+# ---------------------------------------------------------------------------
+# satellites: timer reset, monitor writers, marker hygiene
+# ---------------------------------------------------------------------------
+def test_timer_reset_clears_last():
+    from deepspeed_tpu.utils.timer import _Timer
+
+    t = _Timer("t")
+    assert t.last() == 0.0  # defined before any stop
+    t.start()
+    t.stop()
+    assert t.last() > 0.0
+    t.reset()
+    assert t.last() == 0.0  # regression: reset used to leave _last stale
+    assert t.elapsed(reset=False) == 0.0
+
+
+def test_csv_monitor_appends_and_groups_by_label(tmp_path):
+    from deepspeed_tpu.monitor.monitor import CsvMonitor
+
+    cfg = types.SimpleNamespace(enabled=True, output_path=str(tmp_path),
+                                job_name="job")
+    mon = CsvMonitor(cfg)
+    mon.write_events([("Train/loss", 1.0, 1), ("Train/lr", 0.1, 1),
+                      ("Train/loss", 0.5, 2)])
+    mon.write_events([("Train/loss", 0.25, 3)])  # second flush appends
+    loss = (tmp_path / "job" / "Train_loss.csv").read_text().splitlines()
+    assert loss[0] == "step,Train/loss"  # header written once
+    assert loss[1:] == ["1,1.0", "2,0.5", "3,0.25"]
+    lr = (tmp_path / "job" / "Train_lr.csv").read_text().splitlines()
+    assert lr == ["step,Train/lr", "1,0.1"]
+
+
+def test_comet_monitor_throttles_by_samples_log_interval(monkeypatch):
+    logged = []
+
+    class _Exp:
+        def log_metric(self, label, value, step=None):
+            logged.append((label, value, step))
+
+        def set_name(self, name):
+            self.name = name
+
+    stub = types.ModuleType("comet_ml")
+    stub.start = lambda **kw: _Exp()
+    monkeypatch.setitem(sys.modules, "comet_ml", stub)
+    from deepspeed_tpu.monitor.monitor import CometMonitor
+
+    cfg = types.SimpleNamespace(enabled=True, samples_log_interval=3)
+    mon = CometMonitor(cfg)
+    assert mon.enabled and mon.experiment is not None
+    mon.write_events([("loss", float(s), s) for s in range(1, 10)])
+    assert [step for _, _, step in logged] == [3, 6, 9]
+
+
+def test_wandb_monitor_groups_events_by_step(monkeypatch):
+    calls = []
+    stub = types.ModuleType("wandb")
+    stub.init = lambda **kw: None
+    stub.log = lambda row, step=None: calls.append((step, dict(row)))
+    monkeypatch.setitem(sys.modules, "wandb", stub)
+    from deepspeed_tpu.monitor.monitor import WandbMonitor
+
+    cfg = types.SimpleNamespace(enabled=True, project=None, group=None,
+                                team=None)
+    mon = WandbMonitor(cfg)
+    assert mon.enabled
+    mon.write_events([
+        ("loss", 1.0, 1), ("lr", 0.1, 1), ("scale", 2.0, 1),
+        ("loss", 0.5, 2), ("lr", 0.1, 2),
+    ])
+    # one wandb.log per STEP with all of that step's labels, not one per event
+    assert calls == [
+        (1, {"loss": 1.0, "lr": 0.1, "scale": 2.0}),
+        (2, {"loss": 0.5, "lr": 0.1}),
+    ]
+
+
+def test_marker_hygiene_superset_rule():
+    """Every perf/nightly test must carry `slow` (added by the conftest
+    hook) — the invariant that keeps tier-1's `-m 'not slow'` lane at the
+    fast-lane size.  The audit runs at collection time, BEFORE the -m
+    filter deselects anything, so it sees perf/nightly items even in the
+    fast lane."""
+    import conftest
+
+    assert conftest.MARKER_AUDIT["ran"]
+    # in a full-suite run the audit sees every perf/nightly item pre-filter
+    # (checked > 0); a single-file run may legitimately collect none
+    assert conftest.MARKER_AUDIT["violations"] == []
+
+    # and the hook itself adds the superset marker (unit-level guard)
+    class _Item:
+        def __init__(self, marks):
+            self.marks = set(marks)
+            self.nodeid = "fake"
+
+        def get_closest_marker(self, name):
+            return name if name in self.marks else None
+
+        def add_marker(self, mark):
+            self.marks.add(mark.name)
+
+    items = [_Item({"perf"}), _Item({"nightly"}), _Item(set())]
+    conftest.pytest_collection_modifyitems(None, items)
+    assert "slow" in items[0].marks and "slow" in items[1].marks
+    assert "slow" not in items[2].marks
