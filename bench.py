@@ -239,6 +239,197 @@ def _spec_serve_section(
     return out
 
 
+def chaos_serve_main(smoke=False):
+    """Fault-injection serving storm (`python bench.py --serving --chaos
+    [--smoke]`): the availability proof for the fault-tolerance layer.
+
+    A seeded :class:`FaultInjector` fires runner exceptions (transient AND
+    uid-targeted fatal), NaN-logits sentinels, allocator-exhaustion races,
+    and slow ticks into a shared-prefix arrival workload (>= 64 requests on
+    TPU; CI-smoke sized off-TPU), plus deterministic cancellations and one
+    sacrificial sub-millisecond deadline.  The JSON reports **availability**
+    — the fraction of NON-injected requests reaching FINISHED within their
+    deadline — and gates on the zero-leak allocator invariant (audit + every
+    block back in free/cached) and on every request reaching a typed
+    terminal state (the engine never dies).
+
+    With injection disabled the chaos path must be byte-identical to plain
+    serving: the same workload runs on an engine WITHOUT any fault/serve
+    kwargs, and the per-request tokens must match exactly — asserted every
+    run, so the fault machinery is provably zero-cost when idle."""
+    from deepspeed_tpu.inference import scheduler as sched_mod
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.faults import FaultInjector
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and not smoke:
+        cfg = get_preset("llama3_proxy_410m")
+        params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.bfloat16)
+        n_req, sys_len, sfx_len, max_new = 64, 128, 32, 24
+        ekw = dict(max_seqs=8, num_blocks=192, block_size=32,
+                   max_seq_len=704, prefill_buckets=(64, 128, 256),
+                   prefill_budget=256, prefill_chunk=256)
+        deadline_ms = 600_000.0
+    else:
+        cfg = get_preset("tiny", max_seq_len=256, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+        n_req, sys_len, sfx_len, max_new = 16, 16, 8, 8
+        ekw = dict(max_seqs=4, num_blocks=64, block_size=8,
+                   max_seq_len=128, prefill_buckets=(16, 32, 64),
+                   prefill_budget=64, prefill_chunk=32)
+        deadline_ms = 600_000.0
+    samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
+
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+    prompts = {
+        u: sys_prompt + rng.integers(1, cfg.vocab_size, sfx_len).tolist()
+        for u in range(1, n_req + 1)
+    }
+    arrival_steps = np.cumsum(rng.poisson(1.0, n_req))
+
+    def drive(eng, cancel_uids=()):
+        """Arrival-driven serve loop tolerant of shed-mode rejections
+        (RETRY_LATER resubmits once the shed clears) — every request reaches
+        a typed terminal state before this returns.  ``cancel_uids`` are
+        cancelled as soon as they are live (cancel-from-queue path)."""
+        sched = eng.scheduler
+        backlog = []  # uids rejected RETRY_LATER, resubmitted later
+        pending_cancels = set(cancel_uids)
+        submitted = 0
+
+        def all_done():
+            return (submitted >= n_req and not backlog
+                    and all(sched.requests[u].state in sched_mod.TERMINAL
+                            for u in range(1, n_req + 1)))
+
+        ticks = 0
+        while not all_done():
+            while (submitted < n_req
+                   and arrival_steps[submitted] <= sched.tick_no):
+                uid = submitted + 1
+                submitted += 1
+                res = sched.try_submit(uid, prompts[uid], samp,
+                                       deadline_ms=deadline_ms)
+                if res.reason == sched_mod.RETRY_LATER:
+                    backlog.append(uid)
+                else:
+                    assert res.accepted, res
+            if backlog and not sched.shedding:
+                res = sched.try_submit(backlog[0], prompts[backlog[0]], samp,
+                                       deadline_ms=deadline_ms)
+                if res.accepted:
+                    backlog.pop(0)
+            for uid in list(pending_cancels):
+                req = sched.requests.get(uid)
+                if req is not None and req.state not in sched_mod.TERMINAL:
+                    sched.cancel(uid)
+                    pending_cancels.discard(uid)
+            sched.tick()
+            ticks += 1
+            if ticks > 100_000:
+                raise RuntimeError("chaos drive loop did not converge")
+        out = {}
+        for u in range(1, n_req + 1):
+            req = sched.requests[u]
+            out[u] = (req.state, sched.pop_result(u))
+        return out
+
+    # --- injection-disabled identity: the chaos path on a fault-free engine
+    # must match a PLAIN serving engine token-for-token ---------------------
+    plain = InferenceEngineV2(params, cfg, enable_prefix_caching=True, **ekw)
+    plain_out = drive(plain)
+    idle = InferenceEngineV2(
+        params, cfg, enable_prefix_caching=True, faults=None,
+        serve=dict(deadline_ms=deadline_ms, max_retries=3,
+                   retry_backoff_ms=1.0, shed_queue_depth=n_req + 1), **ekw,
+    )
+    idle_out = drive(idle)
+    identical = idle_out == plain_out
+    assert identical, "fault layer changed tokens with injection disabled"
+
+    # --- the storm ---------------------------------------------------------
+    fatal_victims = [3, 11]
+    nan_victims = [5, 13]
+    cancel_victims = [7]
+    inj = (
+        FaultInjector(seed=0)
+        .arm("runner_exception", p=0.05, transient=True)
+        .arm("runner_exception", uids=fatal_victims)
+        .arm("nan_logits", uids=nan_victims, times=len(nan_victims))
+        .arm("alloc_exhaustion", p=0.05, transient=True, times=8)
+        .arm("slow_tick", p=0.1, delay_s=0.002, times=10)
+    )
+    storm = InferenceEngineV2(
+        params, cfg, enable_prefix_caching=True, faults=inj,
+        serve=dict(deadline_ms=deadline_ms, max_retries=4,
+                   retry_backoff_ms=1.0, shed_queue_depth=max(2, n_req // 8)),
+        **ekw,
+    )
+    sched = storm.scheduler
+    # one sacrificial sub-ms deadline exercises TIMED_OUT deterministically
+    # (uid 0 is outside the workload's 1..n_req population)
+    sched.submit(0, prompts[1], samp, deadline_ms=0.001)
+    t0 = time.perf_counter()
+    storm_out = drive(storm, cancel_uids=cancel_victims)
+    storm_dt = time.perf_counter() - t0
+    timed_out_state = sched.requests[0].state
+    sched.pop_result(0)
+
+    injected = set(fatal_victims) | set(nan_victims) | set(cancel_victims)
+    healthy = [u for u in range(1, n_req + 1) if u not in injected]
+    finished = [u for u in healthy if storm_out[u][0] == "finished"]
+    availability = len(finished) / len(healthy)
+    # zero-leak invariant after the storm
+    alloc = storm.mgr.allocator
+    alloc.audit()
+    in_use = sum(1 for b in range(alloc.total_blocks) if alloc.refcount(b) > 0)
+    leak_ok = (in_use == 0
+               and alloc.free_blocks + alloc.cached_blocks == alloc.total_blocks)
+    all_terminal = all(st in ("finished", "failed", "timed_out", "cancelled")
+                       for st, _ in storm_out.values())
+    # healthy requests must ALSO produce the exact fault-free tokens (greedy
+    # fp32 off-TPU; on TPU bf16 near-ties can flip so this is CPU-gated)
+    tokens_ok = None
+    if not on_tpu:
+        tokens_ok = all(storm_out[u][1] == plain_out[u][1] for u in finished)
+    stats = dict(sched.stats)
+    estats = dict(storm.stats)
+    print(json.dumps({
+        "metric": "serve_chaos_availability_fraction",
+        "value": round(availability, 4),
+        "unit": "fraction",
+        "extra": {
+            "requests": n_req, "injected_requests": sorted(injected),
+            "storm_seconds": round(storm_dt, 2),
+            "faults_fired": inj.fired(),
+            "terminal_states": {
+                s: sum(1 for st, _ in storm_out.values() if st == s)
+                for s in ("finished", "failed", "timed_out", "cancelled")
+            },
+            "sacrificial_deadline_state": timed_out_state,
+            "failed": estats["failed"], "timed_out": estats["timed_out"],
+            "cancelled": estats["cancelled"], "retries": estats["retries"],
+            "nan_failures": estats["nan_failures"],
+            "isolation_probes": estats["isolation_probes"],
+            "shed_transitions": estats["shed_transitions"],
+            "shed_rejections": estats["shed_rejections"],
+            "preemptions": stats["preemptions"],
+            "allocator_leak_check": "pass" if leak_ok else "fail",
+            "all_requests_terminal": all_terminal,
+            "healthy_tokens_match_fault_free": tokens_ok,
+            "injection_disabled_token_identical": identical,
+        },
+    }))
+    assert leak_ok, "allocator leaked blocks across the chaos storm"
+    assert all_terminal, "a request was lost (no typed terminal state)"
+    assert timed_out_state == "timed_out", timed_out_state
+    assert availability == 1.0, f"healthy requests lost: {availability}"
+
+
 def serving_main(quant=None, spec=False, smoke=False):
     """Serving throughput: continuous-batching decode at batch 64 on one
     chip (`python bench.py --serving [--quant int8|fp8]`).  Prints one JSON
@@ -1058,7 +1249,9 @@ if __name__ == "__main__":
         q = sys.argv[sys.argv.index("--quant") + 1]
     spec = "--spec" in sys.argv
     smoke = "--smoke" in sys.argv
-    if "--serving" in sys.argv:
+    if "--serving" in sys.argv and "--chaos" in sys.argv:
+        chaos_serve_main(smoke=smoke)
+    elif "--serving" in sys.argv:
         serving_main(quant=q, spec=spec, smoke=smoke)
     elif "--offload" in sys.argv:
         offload_main()

@@ -1,4 +1,5 @@
-"""Checkpoint save/load: topology-free by construction.
+"""Checkpoint save/load: topology-free by construction, crash-safe by
+write discipline.
 
 TPU-native counterpart of the reference's checkpoint path
 (``engine.save_checkpoint`` runtime/engine.py:3218, ``load_checkpoint``
@@ -14,19 +15,151 @@ Layout (mirrors the reference's tag-directory scheme):
 
     <dir>/latest                      # text file holding the newest tag
     <dir>/<tag>/state/                # orbax pytree (TrainState)
-    <dir>/<tag>/meta.json             # steps, config echo, client_state
+    <dir>/<tag>/meta.json             # steps, config echo, client_state,
+                                      # per-shard sha256 checksums
+
+Crash safety (a kill at ANY point must leave a loadable checkpoint):
+
+1. the tag is written as ``<tag>.tmp`` first — shards, then ``meta.json``
+   carrying a sha256 per file, every file fsynced;
+2. one atomic ``rename(<tag>.tmp, <tag>)`` publishes it (+ directory
+   fsync), so a torn tag directory can only ever be a ``.tmp`` leftover;
+3. ``latest`` is rewritten (atomically, via its own tmp + rename) ONLY
+   after the rename is durable — it can never point at an incomplete tag.
+   For async saves the whole publish sequence runs in the engine's commit
+   callback, after the background serialization has finished.
+
+``load_checkpoint`` verifies the tag (meta present, checksums match) before
+restoring; when ``latest`` names a torn/corrupt save it falls back to the
+newest previous tag that verifies, with a warning.  The
+``checkpoint_crash`` fault-injection point (inference/faults.py) fires
+between the stages so the chaos suite can kill the save mid-write.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
 from ..utils.logging import log_dist
 
 LATEST_FILE = "latest"
+TMP_SUFFIX = ".tmp"
+
+
+def _ckpt_fault(stage: str) -> None:
+    """Scoped crash injection between write stages (no-op unless a
+    fault-injection scope is installed — see inference/faults.py)."""
+    try:
+        from ..inference import faults as _faults
+    except Exception:
+        return
+    _faults.check("checkpoint_crash", stage=stage)
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # filesystem without fsync support (tmpfs variants)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _tree_checksums(root: str, fsync: bool = False) -> Dict[str, str]:
+    """sha256 per file under ``root`` (relpath keys, meta.json excluded —
+    it carries the map).  With ``fsync`` every hashed file is also synced,
+    so the checksum map doubles as the durability barrier walk."""
+    out: Dict[str, str] = {}
+    for dirpath, _, files in os.walk(root):
+        for name in sorted(files):
+            p = os.path.join(dirpath, name)
+            rel = os.path.relpath(p, root)
+            if rel == "meta.json":
+                continue
+            out[rel] = _file_sha256(p)
+            if fsync:
+                _fsync_file(p)
+    return out
+
+
+def verify_tag(load_dir: str, tag: str) -> Optional[str]:
+    """Integrity check of one tag directory; returns None when it verifies
+    or a human-readable reason.  Checkpoints written before checksums
+    existed (no ``shard_checksums`` in meta) verify on structure only."""
+    path = os.path.join(load_dir, tag)
+    if not os.path.isdir(path):
+        return f"tag directory missing: {path}"
+    meta_p = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_p):
+        return "meta.json missing (torn save: shards without commit record)"
+    try:
+        with open(meta_p) as fh:
+            meta = json.load(fh)
+    except (OSError, ValueError) as e:
+        return f"meta.json unreadable: {e}"
+    if not os.path.isdir(os.path.join(path, "state")):
+        return "state/ missing"
+    sums = meta.get("shard_checksums")
+    if sums is None:
+        return None  # pre-checksum checkpoint: structural check only
+    for rel, want in sums.items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            return f"shard missing: {rel}"
+        if _file_sha256(p) != want:
+            return f"shard checksum mismatch: {rel}"
+    return None
+
+
+def _candidate_tags(load_dir: str, exclude: Tuple[str, ...] = ()) -> List[str]:
+    """Fallback candidates, newest first: committed tag directories (never
+    ``.tmp`` leftovers), ordered by meta global_steps then mtime."""
+    out = []
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return []
+    for name in names:
+        p = os.path.join(load_dir, name)
+        if name in exclude or name.endswith(TMP_SUFFIX) or not os.path.isdir(p):
+            continue
+        meta_p = os.path.join(p, "meta.json")
+        steps = -1
+        if os.path.exists(meta_p):
+            try:
+                with open(meta_p) as fh:
+                    steps = int(json.load(fh).get("global_steps", -1))
+            except (OSError, ValueError):
+                continue
+            out.append((steps, os.path.getmtime(p), name))
+    out.sort(reverse=True)
+    return [name for _, _, name in out]
 
 
 def _tag(engine, tag: Optional[str]) -> str:
@@ -63,10 +196,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     _settle_deferred_metrics(engine)
     ce = get_checkpoint_engine(engine)
     tag = _tag(engine, tag)
-    path = os.path.abspath(os.path.join(save_dir, tag))
-    os.makedirs(path, exist_ok=True)
+    save_dir = os.path.abspath(save_dir)
+    path = os.path.join(save_dir, tag)
+    tmp_path = path + TMP_SUFFIX
+    if jax.process_index() == 0 and os.path.isdir(tmp_path):
+        import shutil
+
+        shutil.rmtree(tmp_path)  # leftover of a previous torn save
+    os.makedirs(tmp_path, exist_ok=True)
     state = jax.tree_util.tree_map(lambda x: x, engine.state)  # shallow copy
-    ce.save(state, os.path.join(path, "state"))
+    ce.save(state, os.path.join(tmp_path, "state"))
     nvme = getattr(engine, "_nvme_opt", None)
     if nvme is not None and jax.process_index() == 0:
         # NVMe tier: masters + Adam moments live in the swap pool, not the
@@ -74,7 +213,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         # Every process holds an identical replicated pool (grads are globally
         # reduced), so only process 0 writes: N processes writing the same
         # .swp names would race/clobber AND store N identical copies.
-        nvme.save_to(_nvme_dir(path))
+        nvme.save_to(_nvme_dir(tmp_path))
     meta = {
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
@@ -105,22 +244,58 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
             meta["data_sampler"] = ds_state
     if getattr(engine, "curriculum_scheduler", None) is not None:
         meta["curriculum"] = engine.curriculum_scheduler.get_state()
-    if jax.process_index() == 0:
-        # rank-0 only: every process writing meta.json races on shared
-        # filesystems (the reference guards all non-sharded files this way)
-        with open(os.path.join(path, "meta.json"), "w") as fh:
-            json.dump(meta, fh)
 
-    def write_latest():
-        if jax.process_index() == 0:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
-                fh.write(tag)
+    def finalize():
+        """Publish the checkpoint: checksum + fsync the shards, write
+        meta.json into the tmp dir, atomically rename it to the tag name,
+        and only THEN rewrite ``latest``.  Rank-0 only (the reference
+        guards all non-sharded files this way); for async saves this runs
+        in the commit callback, after the background write has finished —
+        a crash at any stage leaves ``latest`` on the previous valid tag."""
+        if jax.process_index() != 0:
+            return
+        _ckpt_fault("after_shards")
+        # the checksum walk doubles as the per-file durability barrier
+        meta["shard_checksums"] = _tree_checksums(tmp_path, fsync=True)
+        meta_p = os.path.join(tmp_path, "meta.json")
+        with open(meta_p, "w") as fh:
+            json.dump(meta, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _ckpt_fault("before_rename")
+        if os.path.isdir(path):  # re-save of an existing tag
+            import shutil
+
+            # swap via rename-aside, NOT rmtree-then-rename: a kill during
+            # an rmtree of the published tag would leave `latest` naming a
+            # missing directory for the whole deletion.  The aside name
+            # keeps the .tmp suffix so a crash leftover is never picked up
+            # as a fallback candidate; the unpublished window is two
+            # renames wide instead of one rmtree wide.
+            aside = path + ".old" + TMP_SUFFIX
+            shutil.rmtree(aside, ignore_errors=True)
+            os.rename(path, aside)
+            os.rename(tmp_path, path)
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            os.rename(tmp_path, path)
+        _fsync_dir(save_dir)
+        _ckpt_fault("before_latest")
+        # 'latest' flips atomically too: write-aside + rename, so a reader
+        # never sees a half-written tag name
+        latest_tmp = os.path.join(save_dir, LATEST_FILE + TMP_SUFFIX)
+        with open(latest_tmp, "w") as fh:
+            fh.write(tag)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(latest_tmp, os.path.join(save_dir, LATEST_FILE))
+        _fsync_dir(save_dir)
 
     if isinstance(ce, AsyncCheckpointEngine) and ce.pending:
         # 'latest' must never point at a partial checkpoint: commit-time only
-        ce.set_commit_callback(write_latest)
+        ce.set_commit_callback(finalize)
     else:
-        write_latest()
+        finalize()
     log_dist(f"saved checkpoint {path}")
     return path
 
@@ -147,10 +322,35 @@ def load_checkpoint(
     _settle_deferred_metrics(engine)  # buffered metrics are pre-restore steps
     ce = get_checkpoint_engine(engine)
     ce.wait()  # a pending async save must land before we read
+    explicit = tag is not None
     tag = tag or get_latest_tag(load_dir)
     if tag is None:
         log_dist(f"no checkpoint found under {load_dir}")
         return None, {}
+    # integrity gate: meta.json present + every shard matches its recorded
+    # checksum.  When `latest` names a torn/corrupt save (crash mid-write,
+    # bitrot), fall back to the newest previous tag that verifies — an
+    # explicitly requested tag is never silently substituted.
+    err = verify_tag(load_dir, tag)
+    if err is not None:
+        if explicit:
+            raise RuntimeError(
+                f"checkpoint tag '{tag}' failed verification: {err}")
+        log_dist(
+            f"WARNING: latest checkpoint '{tag}' failed verification "
+            f"({err}); falling back to the previous valid tag"
+        )
+        fallback = None
+        for cand in _candidate_tags(load_dir, exclude=(tag,)):
+            cand_err = verify_tag(load_dir, cand)
+            if cand_err is None:
+                fallback = cand
+                break
+            log_dist(f"WARNING: candidate '{cand}' also invalid: {cand_err}")
+        if fallback is None:
+            log_dist(f"no valid checkpoint found under {load_dir}")
+            return None, {}
+        tag = fallback
     path = os.path.join(os.path.abspath(load_dir), tag)
     # restore with the engine's own shardings: this is what makes checkpoints
     # topology-free — a run on a different mesh supplies different shardings

@@ -202,6 +202,54 @@ class TelemetryConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Fault-tolerant-serving knobs (inference/scheduler.py lifecycle layer).
+    Consumed by ``InferenceEngineV2(serve=...)`` / ``ServeScheduler`` — the
+    serving stack's config block, not a training-engine key.
+
+    ``deadline_ms`` / ``ttft_deadline_ms``: default per-request end-to-end /
+    first-token deadlines, checked at tick boundaries (None = none; a
+    ``submit()`` may override per request).  ``max_retries``: bounded
+    retries of a transiently-failing dispatch before requests are failed;
+    ``retry_backoff_ms`` is the exponential-backoff base.
+    ``shed_queue_depth``: waiting-queue depth that flips the scheduler into
+    shed mode (new submissions get a typed RETRY_LATER rejection, and
+    speculation is disabled until the queue drains; None = never shed).
+    ``watchdog_tick_ms``: tick-duration watchdog — this many milliseconds
+    per tick, ``watchdog_grace_ticks`` ticks in a row, also enters shed
+    mode (None disables the watchdog)."""
+
+    deadline_ms: Optional[float] = None
+    ttft_deadline_ms: Optional[float] = None
+    max_retries: int = 3
+    retry_backoff_ms: float = 20.0
+    shed_queue_depth: Optional[int] = None
+    watchdog_tick_ms: Optional[float] = None
+    watchdog_grace_ticks: int = 3
+
+    def __post_init__(self):
+        for k in ("deadline_ms", "ttft_deadline_ms", "watchdog_tick_ms"):
+            v = getattr(self, k)
+            if v is not None and v <= 0:
+                raise ConfigError(f"serve.{k} must be positive or None, got {v}")
+        if self.max_retries < 0:
+            raise ConfigError(
+                f"serve.max_retries must be >= 0, got {self.max_retries}")
+        if self.retry_backoff_ms < 0:
+            raise ConfigError(
+                f"serve.retry_backoff_ms must be >= 0, got "
+                f"{self.retry_backoff_ms}")
+        if self.shed_queue_depth is not None and self.shed_queue_depth < 1:
+            raise ConfigError(
+                f"serve.shed_queue_depth must be >= 1 or None, got "
+                f"{self.shed_queue_depth}")
+        if self.watchdog_grace_ticks < 1:
+            raise ConfigError(
+                f"serve.watchdog_grace_ticks must be >= 1, got "
+                f"{self.watchdog_grace_ticks}")
+
+
+@dataclass
 class PrecisionConfig:
     enabled: bool = False
     loss_scale: float = 0.0  # 0 -> dynamic

@@ -5,7 +5,13 @@ engine_v2.py:30 + ragged state in inference/v2/ragged/).
 """
 from .engine import InferenceEngine, init_inference  # noqa: F401
 from .engine_v2 import InferenceEngineV2  # noqa: F401
+from .faults import FaultInjector, InjectedFault, is_transient  # noqa: F401
 from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
-from .sampling import SamplingParams, sample, spec_verify_sample  # noqa: F401
-from .scheduler import ServeRequest, ServeScheduler  # noqa: F401
+from .sampling import SamplingParams, finite_guard, sample, spec_verify_sample  # noqa: F401
+from .scheduler import (  # noqa: F401
+    RETRY_LATER,
+    ServeRequest,
+    ServeScheduler,
+    SubmitResult,
+)
 from .speculative import propose as prompt_lookup_propose  # noqa: F401

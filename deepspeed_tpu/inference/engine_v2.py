@@ -23,7 +23,7 @@ from ..utils.logging import log_dist
 from . import model_runner
 from .paged import init_paged_cache, kv_pool_pspec
 from .ragged import StateManager
-from .sampling import SamplingParams, sample
+from .sampling import SamplingParams, finite_guard, sample
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -58,6 +58,8 @@ class InferenceEngineV2:
         spec_min_match: int = 2,
         spec_lookup_window: int = 1024,
         telemetry=None,
+        serve=None,
+        faults=None,
     ):
         self.cfg = cfg
         # Families the paged v2 path cannot serve yet must refuse loudly
@@ -185,8 +187,20 @@ class InferenceEngineV2:
         self.spec_max_draft = spec_max_draft
         self.spec_min_match = spec_min_match
         self.spec_lookup_window = spec_lookup_window
+        # fault-tolerant-serving knobs (config.ServeConfig or dict): request
+        # deadlines, bounded retries, shed-mode thresholds — consumed by the
+        # ServeScheduler this engine lazily builds
+        from ..config.config import ServeConfig, _coerce
+
+        self.serve = serve if isinstance(serve, ServeConfig) \
+            else _coerce(ServeConfig, serve)
+        # chaos harness (inference/faults.py): a seeded FaultInjector whose
+        # scoped points fire inside this engine's dispatch sites and the
+        # allocator's growth path; None = every check compiles to a no-op
+        self.faults = faults
         self.mgr = StateManager(num_blocks, block_size, max_seqs,
                                 enable_prefix_caching=enable_prefix_caching)
+        self.mgr.faults = faults
         self._scheduler = None
         # telemetry (telemetry/): ``stats`` is now a read-through view over
         # registry counters — same keys, same read semantics, and the
@@ -222,6 +236,18 @@ class InferenceEngineV2:
             "spec_drafts_shed",  # draft sets dropped by _spec_tick's own
             # capacity pre-pass (direct put()/step(); scheduler sheds are
             # counted in its drafts_shed stat)
+            # fault-tolerance transitions (incremented by the paired
+            # ServeScheduler — registry counters are memoized by name, so
+            # the scheduler's handles are these same objects):
+            "failed",  # requests reaching FAILED (isolation / NaN sentinel)
+            "timed_out",  # deadline expirations (TTFT or e2e)
+            "cancelled",  # cancel(uid) calls that landed
+            "retries",  # transient-dispatch retries (bounded backoff loop)
+            "nan_failures",  # FAILED specifically via the -1 logits sentinel
+            "isolation_probes",  # solo re-dispatches after a batch failure
+            "shed_transitions",  # shed-mode flips (both directions)
+            "shed_rejections",  # try_submit calls rejected RETRY_LATER
+            "watchdog_trips",  # tick-duration watchdog firings
         ))
         self.stats = StatsView(self._c)
         reg = self.telemetry.registry
@@ -279,9 +305,12 @@ class InferenceEngineV2:
                 params, cfg_, tokens, seg, pos, pack_pages, last_idx, kv
             )
             # sampling fused into the dispatch: the decode loop never makes a
-            # second device round trip per tick
+            # second device round trip per tick.  finite_guard folds NaN/inf
+            # detection into the same fetch: a poisoned row samples -1 and
+            # the host fails THAT request instead of trusting garbage.
             t, k, p = sampling_triple
-            return sample(logits, SamplingParams(t, k, p), rng), kv
+            sampled = sample(logits, SamplingParams(t, k, p), rng)
+            return finite_guard(logits, sampled), kv
 
         def packed_ctx_impl(params, tokens, seg, pos, pack_pages, last_idx,
                             ctx_tables, ctx_lens, kv, rng, sampling_triple):
@@ -293,7 +322,8 @@ class InferenceEngineV2:
                 ctx_tables, ctx_lens, kv
             )
             t, k, p = sampling_triple
-            return sample(logits, SamplingParams(t, k, p), rng), kv
+            sampled = sample(logits, SamplingParams(t, k, p), rng)
+            return finite_guard(logits, sampled), kv
 
         def cow_impl(kv, src, dst):
             """Copy-on-write page clone: dst pages get src's contents in
@@ -318,7 +348,10 @@ class InferenceEngineV2:
             )
             t, k, p = sampling_triple
             rng, sub = jax.random.split(rng)
-            return sample(logits, SamplingParams(t, k, p), sub), seq_lens + 1, rng, kv
+            sampled = finite_guard(
+                logits, sample(logits, SamplingParams(t, k, p), sub)
+            )
+            return sampled, seq_lens + 1, rng, kv
 
         def decode_burst_impl(params, tokens, seq_lens, block_tables, active,
                               kv, rng, burst, tick, sampling_triple):
@@ -356,7 +389,10 @@ class InferenceEngineV2:
                 logits, draft, n_draft, samp_rows[:, 0], samp_rows[:, 1],
                 top_k, rng, all_greedy=all_greedy,
             )
-            return out, n_out, kv
+            # one non-finite logit anywhere in a row's k+1 verify positions
+            # poisons the whole row (-1 sentinel): accepting drafts scored
+            # by a garbage forward is not partially trustworthy
+            return finite_guard(logits, out), n_out, kv
 
         if self._mesh is not None:
             # pin the result shardings so the KV pool STAYS sharded across
@@ -638,6 +674,7 @@ class InferenceEngineV2:
         starts 0) take the flash-kernel fast path; any non-zero start
         switches the pack to the context-aware dispatch that attends over
         cached pages."""
+        self._maybe_fault("runner_exception", [s.uid for s, _, _ in entries])
         bs = self.block_size
         total = sum(-(-(end - start) // bs) * bs for _, start, end in entries)
         t_pad = _bucket(total, self.prefill_buckets)
@@ -695,6 +732,9 @@ class InferenceEngineV2:
         sp.dispatched()
         self._c["prefill_tokens_dispatched"].inc(n_real)
         self._c["prefill_dispatches"].inc()
+        poison = self._poisoned(
+            [s.uid for s, _, end in entries if end == len(s.tokens)]
+        )
         next_tokens = None
         for j, (s, start, end) in enumerate(entries):
             s.seen_tokens = end
@@ -702,6 +742,20 @@ class InferenceEngineV2:
                 if next_tokens is None:
                     next_tokens = np.asarray(sampled)
                 tok = int(next_tokens[j])
+                if s.uid in poison:
+                    tok = -1
+                if tok < 0:
+                    # finite_guard sentinel: the row's logits were non-finite.
+                    # No token is committed; the -1 in ``out`` tells the
+                    # scheduler to fail THIS request (others keep theirs).
+                    # Every key the sequence itself published — including
+                    # ones from EARLIER chunks of this prompt, whose KV the
+                    # same poisoned forward chain wrote — is retracted so
+                    # suspect pages stop serving prefix-cache hits.
+                    s.error = "non-finite logits in prefill"
+                    self.mgr.quarantine_written(s)
+                    out[s.uid] = -1
+                    continue
                 s.tokens.append(tok)
                 self._set_block_table(s)
                 out[s.uid] = tok
@@ -755,6 +809,24 @@ class InferenceEngineV2:
             self._samp_dev = jnp.array(self._samp_np)
             self._c["sampling_uploads"].inc()
         return self._samp_dev
+
+    # -- fault hooks ---------------------------------------------------------
+    def _maybe_fault(self, point: str, uids) -> None:
+        """Chaos-harness check before a dispatch site.  Raised BEFORE the jit
+        call, so the donated KV pool is never half-consumed by an aborted
+        dispatch — a retry or per-request isolation probe re-dispatches
+        against intact state."""
+        if self.faults is not None:
+            self.faults.maybe_raise(point, uids=uids)
+
+    def _poisoned(self, uids) -> frozenset:
+        """Uids whose rows the chaos harness poisons this tick — injected at
+        the host boundary as the same ``-1`` sentinel ``finite_guard``
+        produces for real non-finite logits, so the full quarantine path
+        (no token committed, reservation rollback, typed failure) runs."""
+        if self.faults is None:
+            return frozenset()
+        return frozenset(self.faults.select("nan_logits", uids))
 
     # -- speculative decoding ------------------------------------------------
     def plan_speculation(
@@ -857,6 +929,7 @@ class InferenceEngineV2:
         if not proposals:
             return {u: [t] for u, t in
                     self._decode_tick(active_seqs, sampling).items()}
+        self._maybe_fault("runner_exception", [s.uid for s in active_seqs])
         B, K = self.mgr.max_seqs, self.spec_max_draft
         K1, bs = K + 1, self.block_size
         tokens = np.zeros(B * K1, np.int32)
@@ -903,10 +976,22 @@ class InferenceEngineV2:
         self._c["spec_seq_forwards"].inc(len(active_seqs))
         out_np, n_out = np.asarray(out_dev), np.asarray(n_out_dev)
         sp.end()  # the fetch above is the tick's host sync
+        poison = self._poisoned([s.uid for s in active_seqs])
         out: Dict[int, List[int]] = {}
         for s in active_seqs:
             n_emit = int(n_out[s.slot])
             emitted = [int(t) for t in out_np[s.slot, :n_emit]]
+            if s.uid in poison or any(t < 0 for t in emitted):
+                # finite_guard poisoned the whole row (NaN anywhere in its
+                # k+1 verify positions): commit nothing, roll back the draft
+                # page reservations, retract its published keys, and
+                # surface the typed failure
+                s.error = "non-finite logits in verify"
+                self.mgr.quarantine_written(s)
+                if self.mgr.truncate_to_length(s):
+                    self._set_block_table(s)
+                out[s.uid] = [-1]
+                continue
             n = int(n_draft[s.slot])
             n_acc = n_emit - 1
             s.tokens.extend(emitted)
@@ -958,6 +1043,7 @@ class InferenceEngineV2:
             tokens[s.slot] = s.tokens[-1]
             seq_lens[s.slot] = s.cur_len - 1  # KV position of the new token
             active[s.slot] = True
+        self._maybe_fault("runner_exception", [s.uid for s in active_seqs])
         self._rng, sub = jax.random.split(self._rng)
         sp = self.telemetry.recorder.start(
             "decode_tick", track=self._ns, hist=self._h["decode_tick_ms"],
@@ -976,9 +1062,23 @@ class InferenceEngineV2:
         self._c["decode_emitted"].inc(len(active_seqs))
         next_tokens = np.asarray(sampled)
         sp.end()  # the fetch above is the tick's host sync
+        poison = self._poisoned([s.uid for s in active_seqs])
         out = {}
         for s in active_seqs:
             tok = int(next_tokens[s.slot])
+            if s.uid in poison:
+                tok = -1
+            if tok < 0:
+                # finite_guard sentinel: fail this row only — no token is
+                # committed, the growth block reserved for it above is
+                # returned, and the keys it published are retracted (its
+                # written KV is suspect) so nothing leaks or pollutes
+                s.error = "non-finite logits in decode"
+                self.mgr.quarantine_written(s)
+                if self.mgr.truncate_to_length(s):
+                    self._set_block_table(s)
+                out[s.uid] = -1
+                continue
             s.tokens.append(tok)
             s.seen_tokens = s.cur_len - 1
             self.mgr.update_hashes(s)
@@ -1003,6 +1103,12 @@ class InferenceEngineV2:
         out = {}
         for s in active_seqs:
             run = runs[s.uid]
+            if run and run[-1] < 0:
+                # finite_guard sentinel (s.error carries the detail): the
+                # sequence is done-with-error; healthy batchmates continue
+                s.done = True
+                out[s.uid] = -1
+                continue
             if sampling.stop_token is not None and sampling.stop_token in run:
                 cut = len(run) - run.index(sampling.stop_token) - 1
                 if cut:  # drop speculated tokens past the stop
@@ -1100,15 +1206,31 @@ class InferenceEngineV2:
         out: Dict[int, int] = {}
         for s in active_seqs:
             row = [int(t) for t in burst[:, s.slot]]
+            poisoned = -1 in row
+            if poisoned:
+                # finite_guard sentinel mid-burst: keep the healthy prefix,
+                # drop everything from the poisoned tick on (later ticks fed
+                # the sentinel back as input and are garbage)
+                row = row[: row.index(-1)]
+                s.done = True
+                s.error = "non-finite logits in decode burst"
             if sampling.stop_token is not None and sampling.stop_token in row:
                 row = row[: row.index(sampling.stop_token) + 1]
                 s.done = True
             s.tokens.extend(row)
             s.seen_tokens = s.cur_len - 1
-            self.mgr.update_hashes(s)
+            if poisoned:
+                # a poisoned burst's KV is suspect — retract the keys this
+                # sequence published rather than serve them as cache hits
+                self.mgr.quarantine_written(s)
+            else:
+                self.mgr.update_hashes(s)
             if s.cur_len >= self.max_seq_len:
                 s.done = True
-            out[s.uid] = s.tokens[-1]
+            # poisoned rows report the sentinel, same contract as step():
+            # the caller must not mistake a stale committed token for a
+            # fresh emission from a failed sequence
+            out[s.uid] = -1 if poisoned else s.tokens[-1]
         return out
 
     def flush(self, uids: Sequence[int]) -> None:
@@ -1128,7 +1250,8 @@ class InferenceEngineV2:
 
             self._scheduler = ServeScheduler(
                 self, prefill_chunk=self.prefill_chunk,
-                kv_watermark=self.kv_watermark,
+                kv_watermark=self.kv_watermark, serve=self.serve,
+                faults=self.faults,
             )
         return self._scheduler
 
@@ -1144,4 +1267,11 @@ class InferenceEngineV2:
         uid = sched.next_uid()
         sched.submit(uid, prompt_tokens, sampling)
         sched.run(wait_for=[uid])
+        req = sched.requests[uid]
+        if req.state != "finished":
+            # a failed/timed-out/cancelled one-shot has no partial-result
+            # contract to honor — surface the typed terminal state loudly
+            state, err = req.state, req.error
+            sched.pop_result(uid)
+            raise RuntimeError(f"generate() request {state}: {err or state}")
         return sched.pop_result(uid)

@@ -184,6 +184,20 @@ class BlockedAllocator:
         """The published content key of ``block`` (None if unkeyed)."""
         return self._key_of.get(block)
 
+    def invalidate(self, block: int) -> None:
+        """Retract ``block``'s published content key (cascading every cached
+        descendant, exactly like eviction) WITHOUT touching refcounts — the
+        quarantine path for suspect content: a block whose pages may hold
+        NaN KV must stop serving prefix-cache hits, but sequences already
+        holding references keep them (they fail on their own logits)."""
+        self._check(block)
+        self._drop_key(block)
+        if self._refs[block] == 0 and block in self._lru:
+            # a de-keyed block is dead cache: straight to the free list
+            # (audit forbids unkeyed blocks in the LRU)
+            del self._lru[block]
+            self._free.append(block)
+
     def lookup(self, key) -> Optional[int]:
         """Block currently holding content ``key`` (caller must ``ref`` it)."""
         return self._by_key.get(key)
@@ -230,6 +244,10 @@ class SequenceDescriptor:
     spec_cooldown: int = 0  # plain-decode ticks left before a re-probe
     spec_drafted: int = 0  # lifetime drafted tokens (stats)
     spec_accepted: int = 0  # lifetime accepted tokens (stats)
+    # set by the engine when this sequence's forward produced non-finite
+    # logits (finite_guard sentinel) — the scheduler converts it into a
+    # typed FAILED terminal state; direct put()/step() callers read it here
+    error: Optional[str] = None
 
     @property
     def cur_len(self) -> int:
@@ -258,6 +276,11 @@ class StateManager:
         self._free_slots = list(range(max_seqs))
         self.enable_prefix_caching = enable_prefix_caching
         self.cow_hook: Optional[Callable[[int, int], None]] = None
+        # chaos-harness hook (inference/faults.py FaultInjector): when set,
+        # ``ensure_capacity`` consults the ``alloc_exhaustion`` injection
+        # point before touching the real pool — the scheduler's retry /
+        # preemption paths then run against deterministic pressure
+        self.faults = None
         self.prompt_tokens_total = 0
         self.cached_prompt_tokens = 0
         self.cow_copies = 0
@@ -316,6 +339,10 @@ class StateManager:
     def ensure_capacity(self, seq: SequenceDescriptor, new_tokens: int) -> None:
         n = self.blocks_needed(seq, new_tokens)
         if n:
+            if self.faults is not None:
+                # only growth consults the injector: a no-growth call must
+                # stay infallible (retry loops rely on it converging)
+                self.faults.maybe_raise("alloc_exhaustion", uids=(seq.uid,))
             seq.blocks.extend(self.allocator.allocate(n))
 
     def ensure_writable(self, seq: SequenceDescriptor, pos: int) -> None:
@@ -413,6 +440,22 @@ class StateManager:
             # the parent id is reused — unreachable at best, wrong at worst
             if parent is None or self.allocator.key_of(parent) is not None:
                 self.allocator.register(seq.blocks[i], key, parent=parent)
+
+    def quarantine_written(self, seq: SequenceDescriptor) -> None:
+        """Retract the prefix-cache keys of every block SEQ ITSELF wrote and
+        published (its hash chain past the admission-matched prefix) — the
+        engine calls this when the sequence's forward produced non-finite
+        logits, since KV written by that forward (including earlier chunks
+        of the same prompt) is suspect.  Blocks matched FROM the cache were
+        written by healthy requests and keep their keys; so do duplicate
+        keys whose canonical holder is another request's block."""
+        if not self.enable_prefix_caching:
+            return
+        first_own = seq.cached_tokens // self.block_size
+        for i in range(first_own, min(len(seq.hashes), len(seq.blocks))):
+            b = seq.blocks[i]
+            if self.allocator.key_of(b) == seq.hashes[i]:
+                self.allocator.invalidate(b)
 
     def release(self, uid: int) -> None:
         seq = self.seqs.pop(uid)

@@ -143,6 +143,27 @@ def spec_verify_sample(
     return out, (n_acc + 1).astype(jnp.int32)
 
 
+def finite_guard(logits: jnp.ndarray, sampled: jnp.ndarray) -> jnp.ndarray:
+    """NaN/inf detector fused into the sampling dispatch: rows whose logits
+    contain ANY non-finite value return the sentinel token ``-1`` instead of
+    a sample.  Logits never leave the device in the serve loop (sampling is
+    fused into every dispatch), so the engine cannot inspect them host-side
+    — the sentinel is the one-int32 channel that carries "this row's forward
+    produced garbage" back with the tokens it already fetches.  The host
+    treats ``-1`` as a per-request failure (quarantine + page release), not
+    an engine error: one poisoned request must not take down the batch.
+
+    ``logits`` may have extra leading dims (verify packs are [B, k+1, v]);
+    the reduction collapses everything past the row axis, so one bad
+    position poisons its whole row — partial trust in a forward that
+    produced NaN anywhere is not worth the ambiguity."""
+    ok = jnp.all(jnp.isfinite(logits.reshape(sampled.shape[0], -1)), axis=-1)
+    bad = jnp.full_like(sampled, -1)
+    if sampled.ndim > 1:
+        ok = ok.reshape((-1,) + (1,) * (sampled.ndim - 1))
+    return jnp.where(ok, sampled, bad)
+
+
 def sample(logits: jnp.ndarray, params: SamplingParams, rng: jax.Array) -> jnp.ndarray:
     """logits [B, v] -> token ids [B]."""
     if params.temperature <= 0.0:

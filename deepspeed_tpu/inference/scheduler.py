@@ -1,11 +1,13 @@
 """Serving scheduler: continuous batching with queueing admission, chunked
-prefill, and preemption-by-recompute over the paged-KV engine.
+prefill, preemption-by-recompute, and a fault-tolerance layer (typed
+lifecycle states, deadlines, cancellation, per-request failure isolation,
+watchdog/shed degradation) over the paged-KV engine.
 
 The FastGen serve-loop analogue (reference ``mii``/DeepSpeed-FastGen blog +
 ``inference/v2/scheduling_utils.py``): ``submit()`` never throws on capacity
 — requests wait in a FIFO queue and each ``tick()`` runs
 
-    admission  ->  chunked prefill  ->  decode
+    expire  ->  admission  ->  chunked prefill  ->  decode  ->  degradation
 
 * **Admission** pops waiting requests in arrival order under a watermark:
   a request is admitted only if its fresh (non-prefix-cached) prompt blocks
@@ -16,21 +18,36 @@ The FastGen serve-loop analogue (reference ``mii``/DeepSpeed-FastGen blog +
   aging).
 * **Chunked prefill** (Dynamic SplitFuse shape): each tick dispatches at
   most ``prefill_chunk`` prompt tokens, page-aligned, so one long prompt
-  never stalls the decoding batch for its whole forward pass — and prompts
-  longer than the largest prefill bucket become servable at all (the
-  ``put()`` fast path rejects them).  Continuation chunks attend over the
-  already-written pages via the engine's context-aware packed prefill; a
-  prefix-cache hit is just a chunk whose context came from another request.
-* **Decode** runs one batched tick over the scheduler's running set only
-  (``put()``-admitted sequences are not side-driven).  When page growth
-  finds the pool truly exhausted, the youngest running request is preempted
-  by recompute: its pages are released (full pages stay in the prefix-cache
-  LRU), and it requeues at the FRONT with prompt = everything generated so
-  far — re-prefill is then mostly cache hits.
+  never stalls the decoding batch for its whole forward pass.
+* **Decode** runs one batched tick over the scheduler's running set only.
+  When page growth finds the pool truly exhausted, the youngest running
+  request is preempted by recompute.
 
-TPU note: a tick is two static-shape dispatches (one prefill pack + one
-decode batch), not the reference's single mixed ragged batch — fusing both
-into one kernel launch is a Pallas-kernel-level follow-up.
+Fault tolerance (the robustness layer on top):
+
+* **Typed terminal states** — every request ends in exactly one of
+  ``FINISHED`` / ``FAILED`` / ``TIMED_OUT`` / ``CANCELLED``, all reached
+  through the single ``_release()`` path, so block release is leak-free from
+  ANY state (queued, mid-prefill-chunk, mid-draft, preempted-in-queue).
+* **Deadlines** — per-request end-to-end and TTFT deadlines (defaults from
+  ``ServeConfig``, per-request overrides on ``submit``), checked at tick
+  boundaries; an expired request transitions to ``TIMED_OUT`` and frees its
+  pages before the tick does any work.
+* **Cancellation** — ``cancel(uid)`` from any non-terminal state.
+* **Per-request failure isolation** — a tick-level guard catches runner
+  exceptions: transient failures (``faults.is_transient``: allocator races,
+  device-put hiccups, injected-transient) retry with bounded exponential
+  backoff; persistent failures fall back to per-request solo dispatches so
+  only the implicated request(s) FAIL (error recorded on the request,
+  quarantined in ``requests`` until popped) while the batch continues.
+  NaN/inf logits arrive as the engine's ``-1`` sentinel and fail exactly the
+  poisoned row.
+* **Watchdog + graceful degradation** — a tick-duration watchdog and a
+  queue-depth exhaustion detector flip the scheduler into *shed mode*:
+  ``try_submit`` returns a typed ``RETRY_LATER`` rejection instead of
+  queueing unboundedly and speculation is disabled until the queue drains.
+  Every transition is counted (``serve/*`` namespace) and visible as a
+  ``shed_mode`` span in the Chrome trace.
 
 One restriction: all concurrently scheduled requests must share the device
 sampling triple (temperature/top_k/top_p) — it is a static jit argument and
@@ -40,14 +57,51 @@ the scheduler drains idle.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..config.config import ServeConfig, _coerce
 from ..telemetry import NULL_REQUEST_TRACE, StatsView, Telemetry
+from .faults import is_transient
 from .sampling import SamplingParams
 
-WAITING, PREFILL, DECODE, FINISHED = "waiting", "prefill", "decode", "finished"
+WAITING, PREFILL, DECODE = "waiting", "prefill", "decode"
+FINISHED, FAILED, TIMED_OUT, CANCELLED = (
+    "finished", "failed", "timed_out", "cancelled"
+)
+TERMINAL = frozenset((FINISHED, FAILED, TIMED_OUT, CANCELLED))
+
+# -- typed submission outcomes (front ends distinguish client error from
+# capacity without parsing exception strings) --------------------------------
+QUEUED = "queued"
+REJECT_DUPLICATE_UID = "duplicate_uid"
+REJECT_EMPTY_PROMPT = "empty_prompt"
+REJECT_PROMPT_TOO_LONG = "prompt_too_long"
+REJECT_POOL_IMPOSSIBLE = "pool_impossible"
+REJECT_SAMPLING_CONFLICT = "sampling_conflict"
+RETRY_LATER = "retry_later"
+# invalid-outright rejections (the caller's bug: retrying cannot help)
+CLIENT_ERRORS = frozenset((
+    REJECT_DUPLICATE_UID, REJECT_EMPTY_PROMPT, REJECT_PROMPT_TOO_LONG,
+    REJECT_POOL_IMPOSSIBLE, REJECT_SAMPLING_CONFLICT,
+))
+
+
+@dataclass(frozen=True)
+class SubmitResult:
+    """Typed handle ``try_submit`` returns: ``accepted`` or a reason enum
+    (``CLIENT_ERRORS`` member = invalid request; ``RETRY_LATER`` = shed
+    mode, back off and resubmit)."""
+
+    uid: int
+    reason: str
+    detail: str = ""
+
+    @property
+    def accepted(self) -> bool:
+        return self.reason == QUEUED
 
 
 @dataclass
@@ -65,6 +119,12 @@ class ServeRequest:
     preemptions: int = 0
     denied_state: Optional[tuple] = None  # admission state at last failed probe
     trace: Any = NULL_REQUEST_TRACE  # telemetry RequestTrace (no-op unless enabled)
+    # fault-tolerance state
+    submit_time: float = 0.0  # scheduler clock at submit (deadline base)
+    deadline_ms: Optional[float] = None  # e2e deadline (None = scheduler default)
+    ttft_deadline_ms: Optional[float] = None
+    error: Optional[str] = None  # recorded cause for FAILED/TIMED_OUT
+    retries: int = 0  # transient-failure retries charged to this request
 
 
 class ServeScheduler:
@@ -74,6 +134,8 @@ class ServeScheduler:
         prefill_chunk: Optional[int] = None,
         kv_watermark: float = 0.0625,
         starvation_ticks: int = 32,
+        serve: Optional[ServeConfig] = None,
+        faults=None,
     ):
         self.engine = engine
         bs = engine.block_size
@@ -82,6 +144,10 @@ class ServeScheduler:
         total = engine.mgr.allocator.total_blocks
         self._watermark_blocks = max(1, round(total * kv_watermark))
         self.starvation_ticks = starvation_ticks
+        self.serve: ServeConfig = serve if isinstance(serve, ServeConfig) \
+            else _coerce(ServeConfig, serve)
+        self.faults = faults if faults is not None \
+            else getattr(engine, "faults", None)
         self.waiting: "deque[ServeRequest]" = deque()
         self.requests: Dict[int, ServeRequest] = {}
         self._running: List[ServeRequest] = []  # admission order
@@ -89,20 +155,37 @@ class ServeScheduler:
         self._triple = None  # shared device sampling triple
         self._uid_counter = 0
         self._spec_budget = self.prefill_chunk  # leftover chunk tokens/tick
+        self._admit_transient = False  # last _try_admit failed transiently
+        # degradation state
+        self._shed = False
+        self._shed_span = None
+        self._slow_streak = 0  # consecutive ticks over watchdog_tick_ms
         # telemetry rides the engine's: one registry per engine+scheduler
-        # pair, ``stats`` a read-through view over "sched/*" counters (the
-        # serving counterpart of the engine's "serve/*" namespace)
+        # pair, ``stats`` a read-through view over "sched/*" counters plus
+        # the fault-tolerance counters living in the paired engine ("serve/*")
+        # namespace — deadline/cancel/shed transitions are serve-level events
         self.telemetry: Telemetry = getattr(engine, "telemetry", None) \
             or Telemetry.ensure(None)
+        self._clock = self.telemetry.clock
         # the engine pre-claimed the paired sched namespace at its own
         # __init__ (sched2/ goes with serve2/ regardless of which engine's
         # scheduler is touched first); standalone construction claims fresh
         self._ns = getattr(engine, "_sched_ns", None) \
             or self.telemetry.claim_prefix("sched")
+        self._eng_ns = getattr(engine, "_ns", "serve")
         self._c = self.telemetry.counters(self._ns, (
             "submitted", "finished", "admissions",
             "preemptions", "queue_wait_ticks", "prefill_chunks",
             "drafts_shed",  # draft sets dropped under pool pressure
+        ))
+        # fault-tolerance transitions count in the paired SERVE namespace
+        # (they are serve-level events; the engine's stats view lists them
+        # too — registry counters are memoized by name, so these are the
+        # very same objects the engine registered at its __init__)
+        self._flt = self.telemetry.counters(self._eng_ns, (
+            "failed", "timed_out", "cancelled", "retries", "nan_failures",
+            "isolation_probes", "shed_transitions", "shed_rejections",
+            "watchdog_trips",
         ))
         self.stats = StatsView(self._c)
 
@@ -114,59 +197,190 @@ class ServeScheduler:
             if uid not in self.requests and uid not in self.engine.mgr.seqs:
                 return uid
 
-    def submit(
+    def try_submit(
         self, uid: int, tokens: Sequence[int],
         sampling: SamplingParams = SamplingParams(),
-    ) -> None:
-        """Queue a request.  Never raises on CAPACITY — only on requests
-        that are invalid outright (duplicate uid, empty prompt, a prompt the
-        engine could never hold even with the whole pool to itself, or a
-        sampling triple conflicting with the currently scheduled batch)."""
+        deadline_ms: Optional[float] = None,
+        ttft_deadline_ms: Optional[float] = None,
+    ) -> SubmitResult:
+        """Queue a request; NEVER raises.  Returns a :class:`SubmitResult`
+        whose reason distinguishes client error (``CLIENT_ERRORS``: the
+        request is invalid outright) from backpressure (``RETRY_LATER``:
+        shed mode — resubmit later).  Capacity that merely requires waiting
+        still queues (``QUEUED``)."""
         tokens = [int(t) for t in tokens]
         if uid in self.requests or uid in self.engine.mgr.seqs:
             # the mgr check covers put()-admitted sequences: deferring the
             # collision to admission would blow up mid-tick instead
-            raise ValueError(f"uid {uid} already in use")
+            return SubmitResult(uid, REJECT_DUPLICATE_UID,
+                                f"uid {uid} already in use")
         if not tokens:
-            raise ValueError("empty prompt")
+            return SubmitResult(uid, REJECT_EMPTY_PROMPT, "empty prompt")
         eng = self.engine
         if len(tokens) >= eng.max_seq_len:
-            raise ValueError(
+            return SubmitResult(
+                uid, REJECT_PROMPT_TOO_LONG,
                 f"prompt length {len(tokens)} leaves no room to generate "
-                f"(max_seq_len {eng.max_seq_len})"
+                f"(max_seq_len {eng.max_seq_len})",
             )
         # the request must fit the pool ALONE at its maximum length — prompt
         # plus full generation budget — or decode growth eventually exhausts
         # the pool with no victim left to preempt and the whole loop dies.
-        # A stop token may end generation earlier, but admission cannot bet
-        # on that; size the pool (or max_new_tokens) for the worst case.
         max_len = min(len(tokens) + sampling.max_new_tokens, eng.max_seq_len)
         blocks = -(-max_len // eng.block_size)
         if blocks > eng.mgr.allocator.total_blocks:
-            raise ValueError(
+            return SubmitResult(
+                uid, REJECT_POOL_IMPOSSIBLE,
                 f"prompt + max_new_tokens needs {blocks} KV blocks; the "
-                f"pool only has {eng.mgr.allocator.total_blocks}"
+                f"pool only has {eng.mgr.allocator.total_blocks}",
             )
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         if not self._running and not self.waiting:
             self._triple = triple
         elif triple != self._triple:
-            raise ValueError(
+            return SubmitResult(
+                uid, REJECT_SAMPLING_CONFLICT,
                 f"sampling triple {triple} conflicts with the scheduled "
-                f"batch's {self._triple} (one static triple per dispatch)"
+                f"batch's {self._triple} (one static triple per dispatch)",
+            )
+        if self._shed:
+            # graceful degradation: a shedding scheduler refuses new load
+            # with a typed retryable rejection instead of queueing
+            # unboundedly behind a backlog it cannot drain
+            self._flt["shed_rejections"].inc()
+            return SubmitResult(
+                uid, RETRY_LATER,
+                "scheduler is shedding load (queue backlog / watchdog); "
+                "retry later",
             )
         req = ServeRequest(uid=uid, prompt=tokens, sampling=sampling,
                            tokens=list(tokens), submit_tick=self.tick_no,
+                           submit_time=self._clock(),
+                           deadline_ms=deadline_ms,
+                           ttft_deadline_ms=ttft_deadline_ms,
                            trace=self.telemetry.request_trace(
-                               uid, ns=getattr(self.engine, "_ns", "serve")))
+                               uid, ns=self._eng_ns))
         req.trace.submitted(prompt_tokens=len(tokens))
         self.requests[uid] = req
         self.waiting.append(req)
         self._c["submitted"].inc()
+        return SubmitResult(uid, QUEUED)
+
+    def submit(
+        self, uid: int, tokens: Sequence[int],
+        sampling: SamplingParams = SamplingParams(),
+        deadline_ms: Optional[float] = None,
+        ttft_deadline_ms: Optional[float] = None,
+    ) -> SubmitResult:
+        """Raising compat wrapper over :meth:`try_submit`: client-error
+        rejections raise ``ValueError`` (as they always did), shed-mode
+        backpressure raises ``RuntimeError``; capacity still queues."""
+        res = self.try_submit(uid, tokens, sampling, deadline_ms=deadline_ms,
+                              ttft_deadline_ms=ttft_deadline_ms)
+        if res.reason in CLIENT_ERRORS:
+            raise ValueError(res.detail)
+        if res.reason == RETRY_LATER:
+            raise RuntimeError(res.detail)
+        return res
 
     def _base_sampling(self) -> SamplingParams:
         t, k, p = self._triple
         return SamplingParams(temperature=t, top_k=k, top_p=p)
+
+    # -- the single release path --------------------------------------------
+    def _release(self, req: ServeRequest, state: str,
+                 error: Optional[str] = None) -> None:
+        """Move ``req`` to a terminal ``state`` from ANY live state, always
+        leak-free: folds the descriptor's spec totals into the trace, frees
+        its pages (full cached blocks retire to the prefix LRU as usual),
+        removes it from whichever structure holds it, and counts the
+        transition.  Every terminal transition in the scheduler funnels
+        through here — finish, failure, timeout, and cancel differ only in
+        the state label and counters."""
+        assert state in TERMINAL, state
+        seq = self.engine.mgr.seqs.get(req.uid)
+        if seq is not None:
+            req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
+            if error is None and seq.error is not None:
+                error = seq.error
+            self.engine.mgr.release(req.uid)
+        if req in self._running:
+            self._running.remove(req)
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+        req.state = state
+        req.error = error
+        if state == FINISHED:
+            self._c["finished"].inc()
+        elif state == FAILED:
+            self._flt["failed"].inc()
+        elif state == TIMED_OUT:
+            self._flt["timed_out"].inc()
+        elif state == CANCELLED:
+            self._flt["cancelled"].inc()
+        req.trace.finished(outcome=state)
+
+    def _fail(self, req: ServeRequest, error: str, nan: bool = False) -> None:
+        """Quarantine ``req``: typed FAILED terminal state with the error
+        recorded on the request (it stays in ``requests`` — with whatever
+        tokens it produced — until the caller pops it)."""
+        if nan:
+            self._flt["nan_failures"].inc()
+        self._release(req, FAILED, error=error)
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel a request from any non-terminal state (queued, mid-prefill
+        chunk, decoding, mid-draft, preempted-back-to-queue).  Returns True
+        if the request transitioned to ``CANCELLED``; False if it is unknown
+        or already terminal (too late to cancel)."""
+        req = self.requests.get(uid)
+        if req is None or req.state in TERMINAL:
+            return False
+        self._release(req, CANCELLED)
+        return True
+
+    # -- deadlines ----------------------------------------------------------
+    def _deadline_of(self, req: ServeRequest) -> Optional[float]:
+        return req.deadline_ms if req.deadline_ms is not None \
+            else self.serve.deadline_ms
+
+    def _ttft_deadline_of(self, req: ServeRequest) -> Optional[float]:
+        return req.ttft_deadline_ms if req.ttft_deadline_ms is not None \
+            else self.serve.ttft_deadline_ms
+
+    def _expire_phase(self) -> None:
+        """Tick-boundary deadline check over every live request (queued AND
+        running): e2e deadline always applies; the TTFT deadline only until
+        the first token lands.  Runs FIRST so an expired request's pages are
+        back in the pool before this tick's admission."""
+        now = self._clock()
+        for req in list(self.waiting) + list(self._running):
+            if req.state in TERMINAL:
+                continue
+            waited_ms = (now - req.submit_time) * 1e3
+            dl = self._deadline_of(req)
+            if dl is not None and waited_ms > dl:
+                self._release(req, TIMED_OUT,
+                              error=f"e2e deadline {dl}ms exceeded")
+                continue
+            tdl = self._ttft_deadline_of(req)
+            if tdl is not None and not req.generated and waited_ms > tdl:
+                self._release(req, TIMED_OUT,
+                              error=f"ttft deadline {tdl}ms exceeded")
+
+    # -- transient-failure retry --------------------------------------------
+    def _backoff(self, attempt: int) -> None:
+        base = self.serve.retry_backoff_ms / 1e3
+        if base > 0:
+            time.sleep(base * (2 ** (attempt - 1)))
+
+    def _charge_retry(self, reqs: Sequence[Optional[ServeRequest]]) -> None:
+        self._flt["retries"].inc()
+        for r in reqs:
+            if r is not None:
+                r.retries += 1
 
     # -- admission ----------------------------------------------------------
     def _try_admit(self, req: ServeRequest) -> bool:
@@ -187,7 +401,25 @@ class ServeScheduler:
             mgr.release(req.uid)
             mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
             return False
-        mgr.ensure_capacity(seq, 0)  # reserve every prompt page up front
+        try:
+            mgr.ensure_capacity(seq, 0)  # reserve every prompt page up front
+        except RuntimeError as e:
+            # roll the tentative admit back cleanly — admission is a probe,
+            # never a place to crash the loop
+            mgr.release(req.uid)
+            mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
+            if is_transient(e):
+                # transient reservation failure (injected allocator race):
+                # retry next tick.  The flag keeps _admit_phase from
+                # memoizing this denial — the pool state did not move, so
+                # the denied_state cache would otherwise pin the request
+                # out forever.
+                self._admit_transient = True
+            else:
+                # a fatal reservation fault must reach a typed terminal
+                # state, not spin in WAITING forever
+                self._fail(req, f"admission reservation failed: {e}")
+            return False
         req.state = PREFILL
         if req.admit_tick < 0:
             req.admit_tick = self.tick_no
@@ -209,22 +441,98 @@ class ServeScheduler:
             # of that moved since this request was last denied
             state = (mgr.free_slots, mgr.allocator.available_blocks,
                      mgr.allocator.registrations)
+            self._admit_transient = False
             denied = req.denied_state == state or not self._try_admit(req)
             if not denied:
                 self.waiting.remove(req)
             else:
-                req.denied_state = state
+                # a transiently-failed probe must NOT be memoized: the pool
+                # state it keyed on did not change, so the cache would deny
+                # the request forever once the transient cleared
+                req.denied_state = None if self._admit_transient else state
                 if self.tick_no - req.submit_tick >= self.starvation_ticks:
                     break  # aged request: nothing may jump the queue past it
 
     # -- prefill ------------------------------------------------------------
+    def _dispatch_prefill(self, entries, sampling) -> Dict[int, int]:
+        """Guarded prefill dispatch: transient failures retry with bounded
+        exponential backoff; a persistent failure falls back to per-entry
+        solo dispatches so only the implicated request(s) fail.  Progress is
+        re-derived from the live descriptors (``seen_tokens``) because a
+        multi-pack dispatch may have completed some packs before failing."""
+        eng = self.engine
+        reqs = [self.requests.get(s.uid) for s, _, _ in entries]
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            # re-derive ranges: completed packs advanced seen_tokens (and
+            # appended first tokens), so a retry must not re-run them
+            live = []
+            done: Dict[int, int] = {}
+            for seq, start, end in entries:
+                req = self.requests.get(seq.uid)
+                if req is None or req.state != PREFILL:
+                    continue
+                if seq.seen_tokens >= end:
+                    if len(seq.tokens) == end + 1:  # sampled its first token
+                        done[seq.uid] = seq.tokens[-1]
+                    elif seq.error is not None:
+                        # a pack that completed before the failure poisoned
+                        # this row (its -1 result died with the exception)
+                        done[seq.uid] = -1
+                    continue
+                live.append((seq, seq.seen_tokens, end))
+            if not live:
+                return done
+            try:
+                out = eng.prefill_entries(live, sampling)
+                out.update(done)
+                return out
+            except Exception as e:  # noqa: BLE001 — the tick-level guard
+                last_err = e
+                if is_transient(e) and attempt < self.serve.max_retries:
+                    attempt += 1
+                    self._charge_retry(reqs)
+                    self._backoff(attempt)
+                    continue
+                break
+        # isolation: one solo dispatch per surviving entry — only requests
+        # whose OWN dispatch still fails are quarantined
+        out = {}
+        for seq, start, end in entries:
+            req = self.requests.get(seq.uid)
+            if req is None or req.state != PREFILL:
+                continue
+            if seq.seen_tokens >= end:
+                if len(seq.tokens) == end + 1:
+                    out[seq.uid] = seq.tokens[-1]
+                elif seq.error is not None:
+                    out[seq.uid] = -1  # poisoned before the batch failure
+                continue
+            self._flt["isolation_probes"].inc()
+            solo_attempt = 0
+            while True:
+                try:
+                    out.update(eng.prefill_entries(
+                        [(seq, seq.seen_tokens, end)], sampling))
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if is_transient(e) and solo_attempt < self.serve.max_retries:
+                        solo_attempt += 1
+                        self._charge_retry([req])
+                        self._backoff(solo_attempt)
+                        continue
+                    self._fail(req, f"prefill dispatch failed: {e}")
+                    break
+        return out
+
     def _prefill_phase(self) -> Dict[int, int]:
         out: Dict[int, int] = {}
         bs = self.engine.block_size
         mgr = self.engine.mgr
         budget = self.prefill_chunk
         entries = []
-        for req in self._running:
+        for req in list(self._running):  # _fail below mutates _running
             if req.state != PREFILL or budget < bs:
                 continue
             seq = mgr.seqs[req.uid]
@@ -234,6 +542,13 @@ class ServeScheduler:
             mgr.extend_match(seq)
             start = seq.seen_tokens
             remaining = len(seq.tokens) - start
+            if remaining <= 0:
+                # fully prefilled but unsampled: only reachable when the row
+                # was poisoned and its result then lost to a same-batch
+                # failure — fail it here rather than let it linger
+                self._fail(req, seq.error or "non-finite logits in prefill",
+                           nan=seq.error is not None)
+                continue
             take = min(remaining, budget)
             if take < remaining:
                 take -= take % bs  # chunk boundaries stay page-aligned
@@ -251,11 +566,11 @@ class ServeScheduler:
             return out
         clock = self.telemetry.clock
         t0 = clock()
-        first = self.engine.prefill_entries(entries, self._base_sampling())
+        first = self._dispatch_prefill(entries, self._base_sampling())
         t1 = clock()
         for seq, start, end in entries:
             r = self.requests.get(seq.uid)
-            if r is not None:
+            if r is not None and r.state == PREFILL:
                 # chunks share the tick's pack dispatch(es); each request's
                 # chunk span carries the shared window + its own token count
                 r.trace.prefill_chunk(t0, t1, end - start)
@@ -263,6 +578,11 @@ class ServeScheduler:
         for req in list(self._running):
             if req.state == PREFILL and req.uid in first:
                 tok = first[req.uid]
+                if tok < 0:
+                    # engine sentinel: this row's logits were non-finite
+                    self._fail(req, mgr.seqs[req.uid].error
+                               or "non-finite logits in prefill", nan=True)
+                    continue
                 req.state = DECODE
                 req.generated.append(tok)
                 req.trace.tokens(1)
@@ -294,6 +614,61 @@ class ServeScheduler:
         self.waiting.appendleft(req)
         self._c["preemptions"].inc()
 
+    @property
+    def _speculating(self) -> bool:
+        # shed mode disables speculation: under pressure the verify's k+1
+        # positions per sequence are pure extra work, and plain decode is
+        # the predictable-latency path the watchdog wants
+        return self.engine.enable_speculation and not self._shed
+
+    def _dispatch_decode(self, survivors: List[ServeRequest],
+                         proposals) -> Dict[int, List[int]]:
+        """Guarded decode/verify dispatch: transient retry with backoff,
+        then per-request solo isolation (each survivor dispatched alone;
+        only those whose own dispatch fails are quarantined)."""
+        eng = self.engine
+        mgr = eng.mgr
+
+        def run(reqs: List[ServeRequest]) -> Dict[int, List[int]]:
+            seqs = [mgr.seqs[r.uid] for r in reqs]
+            if self._speculating:
+                props = {r.uid: proposals[r.uid] for r in reqs
+                         if r.uid in proposals}
+                return eng._spec_tick(seqs, self._base_sampling(), props)
+            return {u: [t] for u, t in
+                    eng._decode_tick(seqs, self._base_sampling()).items()}
+
+        attempt = 0
+        while True:
+            try:
+                return run(survivors)
+            except Exception as e:  # noqa: BLE001
+                if is_transient(e) and attempt < self.serve.max_retries:
+                    attempt += 1
+                    self._charge_retry(survivors)
+                    self._backoff(attempt)
+                    continue
+                break
+        runs: Dict[int, List[int]] = {}
+        for req in survivors:
+            if req.state != DECODE:
+                continue
+            self._flt["isolation_probes"].inc()
+            solo_attempt = 0
+            while True:
+                try:
+                    runs.update(run([req]))
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if is_transient(e) and solo_attempt < self.serve.max_retries:
+                        solo_attempt += 1
+                        self._charge_retry([req])
+                        self._backoff(solo_attempt)
+                        continue
+                    self._fail(req, f"decode dispatch failed: {e}")
+                    break
+        return runs
+
     def _decode_phase(self, decoding: List[ServeRequest]) -> Dict[int, int]:
         out: Dict[int, int] = {}
         eng = self.engine
@@ -309,17 +684,29 @@ class ServeScheduler:
             max_total_draft_tokens=self._spec_budget,
             max_emit={r.uid: r.sampling.max_new_tokens - len(r.generated)
                       for r in decode_live},
-        ) if eng.enable_speculation else {}
+        ) if self._speculating else {}
         for req in decoding:
             if req.state != DECODE:  # preempted by an earlier victim pick
                 continue
             seq = mgr.seqs[req.uid]
+            grow_retries = 0
             while True:
                 try:
                     mgr.ensure_capacity(seq, 1 + len(proposals.get(req.uid, ())))
                     mgr.ensure_writable(seq, seq.cur_len - 1)
                     break
-                except RuntimeError:
+                except RuntimeError as e:
+                    if is_transient(e):
+                        # injected allocator race / transient reservation
+                        # hiccup — NOT real pool pressure: retry in place
+                        # (bounded) instead of preempting an innocent victim
+                        if grow_retries < self.serve.max_retries:
+                            grow_retries += 1
+                            self._charge_retry([req])
+                            self._backoff(grow_retries)
+                            continue
+                        self._fail(req, f"page reservation failed: {e}")
+                        break
                     # shed this request's own in-flight drafts before
                     # preempting anyone — speculation is optional, residency
                     # is not (plain decode needs only one page of growth)
@@ -339,14 +726,16 @@ class ServeScheduler:
         survivors = [r for r in decoding if r.state == DECODE]
         if not survivors:
             return out
-        seqs = [mgr.seqs[r.uid] for r in survivors]
-        if eng.enable_speculation:
-            runs = eng._spec_tick(seqs, self._base_sampling(), proposals)
-        else:
-            runs = {u: [t] for u, t in
-                    eng._decode_tick(seqs, self._base_sampling()).items()}
+        runs = self._dispatch_decode(survivors, proposals)
         for req in survivors:
+            if req.state != DECODE or req.uid not in runs:
+                continue  # failed in isolation (already released)
             emitted = runs[req.uid]
+            if emitted and emitted[-1] < 0:
+                # engine sentinel: non-finite logits in this row's forward
+                self._fail(req, mgr.seqs[req.uid].error
+                           or "non-finite logits in decode", nan=True)
+                continue
             stop = req.sampling.stop_token
             if stop is not None and stop in emitted:
                 # tokens speculated past the stop are dropped from the
@@ -370,19 +759,15 @@ class ServeScheduler:
             or seq.cur_len >= self.engine.max_seq_len
         )
         if done:
-            req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
-            self.engine.mgr.release(req.uid)
-            self._running.remove(req)
-            req.state = FINISHED
-            self._c["finished"].inc()
-            req.trace.finished()
+            self._release(req, FINISHED)
 
     def result(self, uid: int) -> List[int]:
         """Generated tokens with ``generate()`` semantics: trailing stop
-        token stripped, capped at ``max_new_tokens``.  Finished requests
-        stay in ``self.requests`` (pinning their token history) until
-        ``pop_result`` — long-lived serve loops must pop, or host memory
-        grows with every request ever served."""
+        token stripped, capped at ``max_new_tokens``.  Terminal requests
+        stay in ``self.requests`` (pinning their token history and, for
+        FAILED/TIMED_OUT, the recorded ``error``) until ``pop_result`` —
+        long-lived serve loops must pop, or host memory grows with every
+        request ever served."""
         req = self.requests[uid]
         toks = list(req.generated)
         samp = req.sampling
@@ -395,30 +780,93 @@ class ServeScheduler:
         del self.requests[uid]
         return toks
 
+    # -- degradation (watchdog + sustained exhaustion) ----------------------
+    def _set_shed(self, on: bool, reason: str) -> None:
+        if on == self._shed:
+            return
+        self._shed = on
+        self._flt["shed_transitions"].inc()
+        if on:
+            # one span covers the whole shed episode: visible as a block on
+            # the engine's track in the Chrome trace
+            self._shed_span = self.telemetry.recorder.start(
+                "shed_mode", track=self._eng_ns, reason=reason,
+                queue_depth=len(self.waiting), tick=self.tick_no,
+            )
+        else:
+            if self._shed_span is not None:
+                self._shed_span.end(tick_end=self.tick_no)
+                self._shed_span = None
+
+    def _update_degradation(self, tick_ms: float) -> None:
+        wd = self.serve.watchdog_tick_ms
+        if wd is not None:
+            if tick_ms > wd:
+                self._slow_streak += 1
+                if self._slow_streak == self.serve.watchdog_grace_ticks:
+                    self._flt["watchdog_trips"].inc()
+            else:
+                self._slow_streak = 0
+        depth = self.serve.shed_queue_depth
+        queue_over = depth is not None and len(self.waiting) > depth
+        wd_over = wd is not None \
+            and self._slow_streak >= self.serve.watchdog_grace_ticks
+        if not self._shed:
+            if queue_over:
+                self._set_shed(True, "queue_depth")
+            elif wd_over:
+                self._set_shed(True, "watchdog")
+        else:
+            queue_ok = depth is None or len(self.waiting) <= depth // 2
+            if queue_ok and not wd_over:
+                self._set_shed(False, "recovered")
+
+    @property
+    def shedding(self) -> bool:
+        return self._shed
+
+    @property
+    def quarantined(self) -> List[int]:
+        """Uids held in the FAILED terminal state (error recorded on the
+        request) awaiting ``pop_result``."""
+        return [u for u, r in self.requests.items() if r.state == FAILED]
+
     # -- the loop -----------------------------------------------------------
     @property
     def idle(self) -> bool:
         return not self.waiting and not self._running
 
     def tick(self) -> Dict[int, int]:
-        """One scheduler tick: admission -> chunked prefill -> decode.
-        Returns the newest token per request that emitted one (a request
-        finishing its prefill emits its first token; it joins the decode
-        batch from the NEXT tick)."""
+        """One scheduler tick: expire -> admission -> chunked prefill ->
+        decode -> degradation check.  Returns the newest token per request
+        that emitted one (a request finishing its prefill emits its first
+        token; it joins the decode batch from the NEXT tick).  Failed /
+        timed-out / cancelled requests never appear in the returned dict —
+        read their terminal state off ``requests[uid]``."""
         self.tick_no += 1
+        t0 = self._clock()  # BEFORE the fault delay: an injected stall must
+        # land inside the watchdog's measured window or it cannot trip it
+        if self.faults is not None:
+            d = self.faults.delay("slow_tick")
+            if d > 0:
+                time.sleep(d)  # chaos harness: stalls the tick, trips the watchdog
+        self._expire_phase()
         self._admit_phase()
         decoding = [r for r in self._running if r.state == DECODE]
         out = self._prefill_phase()
         out.update(self._decode_phase(decoding))
+        self._update_degradation((self._clock() - t0) * 1e3)
         return out
 
     def run(self, wait_for: Optional[Sequence[int]] = None,
             max_ticks: int = 1_000_000) -> Dict[int, List[int]]:
-        """Tick until every request (or every uid in ``wait_for``) finishes;
-        returns {uid: result}."""
+        """Tick until every request (or every uid in ``wait_for``) reaches a
+        terminal state; returns {uid: result} (partial tokens for non-
+        FINISHED terminals — check ``requests[uid].state``)."""
         def pending() -> bool:
             if wait_for is not None:
-                return any(self.requests[u].state != FINISHED for u in wait_for)
+                return any(self.requests[u].state not in TERMINAL
+                           for u in wait_for)
             return not self.idle
 
         ticks = stalled = 0
@@ -437,6 +885,6 @@ class ServeScheduler:
                     "(KV blocks/slots held by sequences outside the scheduler)"
                 )
         uids = wait_for if wait_for is not None else [
-            u for u, r in self.requests.items() if r.state == FINISHED
+            u for u, r in self.requests.items() if r.state in TERMINAL
         ]
         return {u: self.result(u) for u in uids}

@@ -251,7 +251,7 @@ class RequestTrace:
     __slots__ = ("uid", "_tel", "_h", "prompt_tokens", "submit_ts",
                  "admit_ts", "first_token_ts", "last_emit_ts", "finish_ts",
                  "readmits", "preemptions", "tokens_emitted", "drafted",
-                 "accepted", "chunks", "emissions", "preempt_ts")
+                 "accepted", "chunks", "emissions", "preempt_ts", "outcome")
 
     def __init__(self, tel: "Telemetry", uid: int, prompt_tokens: int = 0,
                  hists: Optional[Dict[str, Any]] = None):
@@ -272,6 +272,7 @@ class RequestTrace:
         self.chunks: List[Tuple[float, float, int]] = []
         self.emissions: List[Tuple[float, int]] = []
         self.preempt_ts: List[float] = []
+        self.outcome: str = "finished"  # terminal state label (typed)
 
     # -- lifecycle ----------------------------------------------------------
     def submitted(self, prompt_tokens: Optional[int] = None) -> None:
@@ -321,7 +322,12 @@ class RequestTrace:
         self.drafted += drafted
         self.accepted += accepted
 
-    def finished(self) -> None:
+    def finished(self, outcome: str = "finished") -> None:
+        """Terminal transition.  ``outcome`` is the typed terminal state
+        (``finished`` / ``failed`` / ``timed_out`` / ``cancelled``) — it
+        rides the summary event and shows as a marker on the request's
+        Chrome-trace track, so deadline/cancel storms are visible per uid."""
+        self.outcome = outcome
         self.finish_ts = self._tel.clock()
         self._tel._finish_request(self)
 
@@ -363,6 +369,7 @@ class RequestTrace:
     def summary(self) -> Dict[str, Any]:
         return {
             "uid": self.uid,
+            "outcome": self.outcome,
             "prompt_tokens": self.prompt_tokens,
             "tokens_emitted": self.tokens_emitted,
             "queue_wait_ms": self.queue_wait_ms,
@@ -398,12 +405,19 @@ class RequestTrace:
         for t in self.preempt_ts:
             evs.append({"name": "preempted", "ph": "X", "pid": pid,
                         "tid": tid, "ts": t * 1e6, "dur": 0.0, "args": {}})
+        if self.finish_ts is not None and self.outcome != "finished":
+            # non-FINISHED terminals (failed/timed_out/cancelled) get an
+            # explicit marker so chaos runs read directly off the timeline
+            evs.append({"name": self.outcome, "ph": "X", "pid": pid,
+                        "tid": tid, "ts": self.finish_ts * 1e6, "dur": 0.0,
+                        "args": {}})
         return evs
 
 
 class _NullRequestTrace:
     __slots__ = ()
     uid = -1
+    outcome = "finished"
     prompt_tokens = 0
     tokens_emitted = 0
     preemptions = 0
@@ -433,7 +447,7 @@ class _NullRequestTrace:
     def add_spec(self, drafted, accepted) -> None:
         pass
 
-    def finished(self) -> None:
+    def finished(self, outcome="finished") -> None:
         pass
 
     def summary(self) -> Dict[str, Any]:

@@ -92,6 +92,126 @@ def test_missing_checkpoint(tmp_path):
     assert path is None
 
 
+# ---------------------------------------------------------------------------
+# crash-safe checkpointing: atomic tmp+rename publish, per-shard checksums,
+# 'latest' only after durability, mid-write-crash + corruption fallback
+# ---------------------------------------------------------------------------
+def test_save_is_atomic_with_shard_checksums(tmp_path):
+    import json
+    import os
+
+    from deepspeed_tpu.checkpoint.saving import _tree_checksums, verify_tag
+
+    e = _engine()
+    for b in random_batches(2, 1, 16):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path), tag="t1")
+    assert not os.path.isdir(tmp_path / "t1.tmp")  # tmp dir renamed away
+    with open(tmp_path / "t1" / "meta.json") as fh:
+        meta = json.load(fh)
+    sums = meta["shard_checksums"]
+    assert sums  # every shard file carries a checksum...
+    assert _tree_checksums(str(tmp_path / "t1")) == sums  # ...that matches
+    assert verify_tag(str(tmp_path), "t1") is None
+
+
+def test_crash_mid_write_keeps_previous_checkpoint(tmp_path):
+    """The fault harness kills the save between shard write and publish:
+    the torn save stays a .tmp leftover, 'latest' still names the previous
+    good tag, load restores it, and a retry of the same tag succeeds."""
+    import os
+
+    from deepspeed_tpu.checkpoint.saving import get_latest_tag
+    from deepspeed_tpu.inference import faults
+    from deepspeed_tpu.inference.faults import CheckpointWriteCrash, FaultInjector
+
+    e = _engine()
+    for b in random_batches(2, 1, 16):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path), tag="good")
+    good_steps = e.global_steps
+    for b in random_batches(1, 1, 16, seed=3):
+        e.train_batch(b)
+    with faults.scope(FaultInjector().arm("checkpoint_crash", times=1)):
+        with pytest.raises(CheckpointWriteCrash):
+            e.save_checkpoint(str(tmp_path), tag="torn")
+    assert get_latest_tag(str(tmp_path)) == "good"  # never repointed
+    assert not os.path.isdir(tmp_path / "torn")  # only a .tmp leftover
+    assert os.path.isdir(tmp_path / "torn.tmp")
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("good")
+    assert e2.global_steps == good_steps
+    # the retry cleans the stale .tmp and publishes normally
+    e.save_checkpoint(str(tmp_path), tag="torn")
+    assert get_latest_tag(str(tmp_path)) == "torn"
+    assert not os.path.isdir(tmp_path / "torn.tmp")
+
+
+def test_latest_published_only_after_rename_durable(tmp_path):
+    """The latest-ordering fix: a crash AFTER the tag rename but BEFORE the
+    'latest' rewrite leaves 'latest' on the previous tag — the fully-written
+    newer directory is simply not yet committed (load follows 'latest')."""
+    import os
+
+    from deepspeed_tpu.checkpoint.saving import get_latest_tag
+    from deepspeed_tpu.inference import faults
+    from deepspeed_tpu.inference.faults import CheckpointWriteCrash, FaultInjector
+
+    e = _engine()
+    for b in random_batches(2, 1, 16):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path), tag="first")
+    # stage targeting via the check counter: after_shards(0),
+    # before_rename(1), before_latest(2)
+    with faults.scope(FaultInjector().arm("checkpoint_crash", after=2, times=1)):
+        with pytest.raises(CheckpointWriteCrash):
+            e.save_checkpoint(str(tmp_path), tag="second")
+    assert os.path.isdir(tmp_path / "second")  # rename landed...
+    assert get_latest_tag(str(tmp_path)) == "first"  # ...but uncommitted
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("first")
+
+
+def test_corrupt_shard_falls_back_to_previous_tag(tmp_path):
+    """Bitrot in the newest checkpoint: checksum verification fails, load
+    warns and falls back to the newest previous tag that verifies; an
+    EXPLICITLY requested corrupt tag raises instead of substituting."""
+    import os
+
+    e = _engine()
+    for b in random_batches(2, 1, 16):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path), tag="older")
+    older_steps = e.global_steps
+    for b in random_batches(2, 1, 16, seed=5):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path), tag="newer")
+    # flip bytes in one shard file of the newest tag
+    victim = None
+    for dirpath, _, files in os.walk(tmp_path / "newer"):
+        for name in files:
+            p = os.path.join(dirpath, name)
+            if name != "meta.json" and os.path.getsize(p) > 0:
+                victim = p
+                break
+        if victim:
+            break
+    assert victim is not None
+    with open(victim, "r+b") as fh:
+        raw = fh.read(16)
+        fh.seek(0)
+        fh.write(bytes(255 - b for b in raw))
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("older")  # fell back
+    assert e2.global_steps == older_steps
+    e3 = _engine()
+    with pytest.raises(RuntimeError, match="failed verification"):
+        e3.load_checkpoint(str(tmp_path), tag="newer")
+
+
 @pytest.mark.nightly  # slow e2e
 def test_async_checkpoint_save_and_resume(tmp_path):
     """checkpoint.async_save: save returns immediately, 'latest' appears only

@@ -9,11 +9,13 @@ import pytest
 
 from deepspeed_tpu.inference import (
     BlockedAllocator,
+    FaultInjector,
     InferenceEngineV2,
     SamplingParams,
     ServeScheduler,
     StateManager,
 )
+from deepspeed_tpu.inference import scheduler as sched_mod
 from deepspeed_tpu.models import get_preset
 from deepspeed_tpu.models.transformer import init_params
 
@@ -338,6 +340,80 @@ def test_generate_does_not_side_drive_put_sequences(tiny):
     len_before = eng.mgr.seqs[50].cur_len
     eng.generate([9, 8, 7], SamplingParams(max_new_tokens=4))
     assert eng.mgr.seqs[50].cur_len == len_before
+
+
+# ---------------------------------------------------------------------------
+# abort paths: the cancel/timeout/failure twin of the preemption invariant
+# test — refcounts return to baseline, the prefix LRU stays consistent,
+# no block leaks, from ANY release point
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # heaviest in its area; nightly lane still runs it
+def test_abort_path_allocator_invariants_randomized_storm(tiny):
+    """Randomized cancel / deadline-timeout / injected-failure storm over
+    the refcounted COW pool: after every step the allocator audits clean and
+    every block's refcount equals its ownership count; after the drain the
+    pool is back at baseline (free + cached == total, zero refs)."""
+    cfg, params = tiny
+    inj = (
+        FaultInjector(seed=1)
+        .arm("runner_exception", p=0.05, transient=True)
+        .arm("runner_exception", p=0.03)  # occasional fatal batch failure
+        .arm("nan_logits", p=0.02)
+        .arm("alloc_exhaustion", p=0.03, transient=True)
+    )
+    eng = _engine(cfg, params, max_seqs=4, num_blocks=32,
+                  enable_prefix_caching=True, faults=inj,
+                  serve=dict(max_retries=2, retry_backoff_ms=0.0))
+    sched = eng.scheduler
+    t = [0.0]
+    sched._clock = lambda: t[0]  # fake clock: deterministic deadline expiry
+    samp = SamplingParams(max_new_tokens=8)
+    rng = np.random.default_rng(2)
+    shared = [int(x) for x in rng.integers(1, 255, 16)]
+    mgr = eng.mgr
+
+    def check():
+        mgr.allocator.audit()
+        owners = {}
+        for s in mgr.seqs.values():
+            for b in s.blocks:
+                owners[b] = owners.get(b, 0) + 1
+        for b in range(mgr.allocator.total_blocks):
+            assert mgr.allocator.refcount(b) == owners.get(b, 0), b
+
+    uid = 0
+    for _ in range(120):
+        op = rng.choice(["submit", "cancel", "expire", "tick", "tick"])
+        if op == "submit":
+            uid += 1
+            kw = {}
+            if rng.random() < 0.3:  # some requests carry tight deadlines
+                kw["deadline_ms"] = float(rng.integers(1, 50))
+            p = shared[: int(rng.integers(4, 16))] + [
+                int(x) for x in rng.integers(1, 255, int(rng.integers(1, 6)))
+            ]
+            sched.try_submit(uid, p, samp, **kw)
+        elif op == "cancel":
+            live = [u for u, r in sched.requests.items()
+                    if r.state not in sched_mod.TERMINAL]
+            if live:
+                sched.cancel(int(rng.choice(live)))
+        elif op == "expire":
+            t[0] += 0.02  # 20 fake ms: expires the tight-deadline cohort
+        else:
+            sched.tick()
+        check()
+    sched.run()  # drain the rest (faults still armed)
+    states = {r.state for r in sched.requests.values()}
+    assert states <= sched_mod.TERMINAL  # everything reached a typed state
+    assert sched.stats["finished"] > 0  # storm didn't just kill everything
+    assert eng.stats["cancelled"] + eng.stats["timed_out"] > 0  # aborts real
+    for u in list(sched.requests):
+        sched.pop_result(u)
+    check()
+    assert not mgr.seqs
+    assert (mgr.allocator.free_blocks + mgr.allocator.cached_blocks
+            == mgr.allocator.total_blocks)
 
 
 # ---------------------------------------------------------------------------
