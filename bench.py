@@ -901,7 +901,90 @@ def quant_kernels_main():
     }))
 
 
-def serve8b_main(quant: str = "int8", spec: bool = False):
+def _serve8b_tp_section(params, cfg, quant, tp, resident_gib, *, B,
+                        prompt_len, steps, blocks_for, block_size, buckets,
+                        budget, samp, rng, on_tpu):
+    """TP serving study: fused-under-shard_map decode throughput, per-shard
+    weight bandwidth, fused-vs-jnp A/B, measured collective cost, and the
+    2-D batch x model mesh dryrun.  Weights arrive PRE-quantized (built
+    leaf-by-leaf; fp6 row kernels packed per K-chunk for this tp), so the
+    engine only shards them — an 8B bf16 tree never materializes."""
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+
+    devs = jax.devices()
+    if len(devs) < tp:
+        raise SystemExit(f"--tp {tp} needs {tp} devices, have {len(devs)}")
+    prompts = [
+        rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(B)
+    ]
+    kw = dict(max_seqs=B, num_blocks=blocks_for(B), block_size=block_size,
+              prefill_buckets=buckets, prefill_budget=budget)
+
+    def run(fused, grid, extra_kw=None):
+        eng = InferenceEngineV2(params, cfg, grid=grid,
+                                fused_serving=fused, **kw, **(extra_kw or {}))
+        eng.put(list(range(1, B + 1)), prompts, samp)
+        eng.step_n(2, samp)  # warm decode (compile outside the window)
+        t0 = time.perf_counter()
+        eng.step_n(steps, samp)
+        dt = (time.perf_counter() - t0) / steps
+        return eng, dt
+
+    grid = initialize_mesh(devices=devs[:tp], model=tp)
+    eng, tick_fused = run(None, grid)
+    _, tick_jnp = run(False, grid)
+    coll_ms = eng.measure_tp_collectives()
+    # per-shard weight traffic: each model shard streams its 1/tp of the
+    # compressed bytes per tick — the roofline coordinate per chip
+    per_shard_gb_s = (resident_gib / tp) * 2**30 / tick_fused / 1e9
+
+    mesh2d = None
+    if len(devs) >= 2 * tp:
+        # 2-D batch x model dryrun: KV pool and slot groups sharded over
+        # the batch axis, weights over model — two serving replicas on one
+        # mesh, decoding token-identically to the 1-D engine
+        grid2 = initialize_mesh(devices=devs[: 2 * tp], batch=2, model=tp)
+        eng2 = InferenceEngineV2(params, cfg, grid=grid2, serve_replicas=2,
+                                 **kw)
+        eng2.put(list(range(1, B + 1)), prompts, samp)
+        t2 = eng2.step(samp)
+        ck = eng2.kv[0][0]
+        mesh2d = {
+            "mesh": {k: v for k, v in grid2.spec.sizes.items() if v > 1},
+            "pool_spec": str(ck.sharding.spec),
+            "blocks_per_replica": ck.addressable_shards[0].data.shape[0],
+            "ticked": len(t2) == B and all(v >= 0 for v in t2.values()),
+            "replicas_used": sorted(
+                {eng2.mgr.replica_of(s) for s in eng2.mgr.seqs.values()}
+            ),
+        }
+
+    print(json.dumps({
+        "metric": f"serve8b_tp{tp}_decode_tokens_per_sec_{quant}",
+        "value": round(B / tick_fused, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {
+            "quantize_weights": quant,
+            "tp": tp,
+            "batch": B,
+            "ms_per_tick": round(1e3 * tick_fused, 2),
+            "per_shard_effective_weight_gb_s": round(per_shard_gb_s, 1),
+            "fused_vs_jnp_speedup": round(tick_jnp / tick_fused, 3),
+            "tp_allreduce_ms_median": (round(coll_ms, 3)
+                                       if coll_ms is not None else None),
+            "weights_resident_gib": round(resident_gib, 2),
+            "mesh_2d_dryrun": mesh2d,
+            "interpret_smoke": not on_tpu,
+            "note": "fused kernels run INSIDE shard_map regions under TP "
+                    "(no set_fused_serving pin); random weights — "
+                    "capacity/throughput proof",
+        },
+    }))
+
+
+def serve8b_main(quant: str = "int8", spec: bool = False, tp: int = 1):
     """Llama-3-8B quantized serving on ONE 16GB v5e
     (`python bench.py --serve8b [--quant int8|fp8|fp6]`): the capacity
     proof — bf16 weights alone are 15 GiB (HBM is 16), int8 + per-output-
@@ -944,7 +1027,7 @@ def serve8b_main(quant: str = "int8", spec: bool = False):
     )
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
 
-    def build_leaf(key, sds, quantize):
+    def build_leaf(key, sds, quantize, row_shards=1):
         def gen(k):
             x = (jax.random.normal(k, sds.shape, jnp.float32) * 0.02).astype(
                 jnp.bfloat16
@@ -952,18 +1035,24 @@ def serve8b_main(quant: str = "int8", spec: bool = False):
             if not quantize:
                 return x
             if quant == "fp6":
-                return quantize_serving_weight_fp6(x)
+                # TP row-parallel fp6 kernels (o/down) pack per K-chunk so
+                # the byte planes shard cleanly on in-features
+                return quantize_serving_weight_fp6(x, row_shards)
             return quantize_serving_weight(x, quant)
 
         return jax.jit(gen)(key)
+
+    from deepspeed_tpu.ops.quantizer import _SERVING_ROW_PATHS
 
     key = jax.random.PRNGKey(0)
     leaves = []
     for kp, sds in flat:
         p = path_str(kp)
         q = any(p.endswith(t) for t in _SERVING_QUANT_PATHS) and sds.ndim >= 2
+        shards = tp if (q and quant == "fp6"
+                        and any(p.endswith(t) for t in _SERVING_ROW_PATHS)) else 1
         key, sub = jax.random.split(key)
-        leaves.append(build_leaf(sub, sds, q))
+        leaves.append(build_leaf(sub, sds, q, shards))
     params = jax.tree_util.tree_unflatten(treedef, leaves)
     resident_gib = tree_nbytes(params) / 2**30
     layer_w = dict(params["layers"]["attn"], mlp=params["layers"]["mlp"])
@@ -1014,6 +1103,22 @@ def serve8b_main(quant: str = "int8", spec: bool = False):
         block_size, buckets, budget = 8, (16,), 16
     rng = np.random.default_rng(0)
     samp = SamplingParams(temperature=0.0, max_new_tokens=steps + 8)
+
+    if tp > 1:
+        # `--serve8b --quant --tp N`: TP serving with the fused kernels ON
+        # (shard_map'd col/row quant-matmul regions) — per-shard effective
+        # weight bandwidth, fused-vs-jnp A/B under TP, the measured
+        # collective cost, and a 2-D batch x model mesh dryrun.  On CPU
+        # this is the virtual-device smoke
+        # (XLA_FLAGS=--xla_force_host_platform_device_count=8); on-chip
+        # numbers land via BENCH_r07.
+        _serve8b_tp_section(
+            params, cfg, quant, tp, resident_gib,
+            B=batches[0], prompt_len=prompt_len, steps=steps,
+            blocks_for=blocks_for, block_size=block_size, buckets=buckets,
+            budget=budget, samp=samp, rng=rng, on_tpu=on_tpu,
+        )
+        return
 
     scaling = []
     tick_headline = None
@@ -1247,6 +1352,9 @@ if __name__ == "__main__":
     q = None
     if "--quant" in sys.argv:
         q = sys.argv[sys.argv.index("--quant") + 1]
+    tp = 1
+    if "--tp" in sys.argv:
+        tp = int(sys.argv[sys.argv.index("--tp") + 1])
     spec = "--spec" in sys.argv
     smoke = "--smoke" in sys.argv
     if "--serving" in sys.argv and "--chaos" in sys.argv:
@@ -1258,7 +1366,7 @@ if __name__ == "__main__":
     elif "--longctx" in sys.argv:
         longctx_main()
     elif "--serve8b" in sys.argv:
-        serve8b_main(quant=q or "int8", spec=spec)
+        serve8b_main(quant=q or "int8", spec=spec, tp=tp)
     elif "--quant-kernels" in sys.argv:
         quant_kernels_main()
     else:

@@ -217,7 +217,14 @@ class ServeConfig:
     speculation is disabled until the queue drains; None = never shed).
     ``watchdog_tick_ms``: tick-duration watchdog — this many milliseconds
     per tick, ``watchdog_grace_ticks`` ticks in a row, also enters shed
-    mode (None disables the watchdog)."""
+    mode (None disables the watchdog).
+
+    ``fused_serving``: tri-state gate for the fused Pallas dequant-matmul
+    kernels (``ops/quantizer.serving_mm``) — None = auto (fused whenever
+    the local shapes qualify, single-chip AND under TP shard_map regions),
+    False = jnp bodies everywhere (the A/B lever), True = auto as well.
+    Per-ENGINE state: it replaced the process-global ``set_fused_serving``
+    switch that let one TP engine pin later engines to the jnp body."""
 
     deadline_ms: Optional[float] = None
     ttft_deadline_ms: Optional[float] = None
@@ -226,6 +233,7 @@ class ServeConfig:
     shed_queue_depth: Optional[int] = None
     watchdog_tick_ms: Optional[float] = None
     watchdog_grace_ticks: int = 3
+    fused_serving: Optional[bool] = None
 
     def __post_init__(self):
         for k in ("deadline_ms", "ttft_deadline_ms", "watchdog_tick_ms"):

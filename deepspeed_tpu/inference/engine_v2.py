@@ -60,6 +60,8 @@ class InferenceEngineV2:
         telemetry=None,
         serve=None,
         faults=None,
+        fused_serving: Optional[bool] = None,
+        serve_replicas: int = 1,
     ):
         self.cfg = cfg
         # Families the paged v2 path cannot serve yet must refuse loudly
@@ -81,6 +83,42 @@ class InferenceEngineV2:
                 "(falcon/gptj/phi layout): the runner wires sequential "
                 "attn_norm/mlp_norm blocks — use init_inference instead"
             )
+        # 2-D batch x model serve mesh: ``serve_replicas`` > 1 partitions
+        # slots and KV blocks into per-replica groups laid out over the
+        # mesh's batch (data) axis — explicit opt-in, because leftover mesh
+        # capacity also lands on the data axis and plain-TP callers expect
+        # replicated behavior there.
+        tp = grid.spec.model if grid is not None else 1
+        dp = int(serve_replicas)
+        if dp > 1:
+            if grid is None or grid.spec.data != dp:
+                raise ValueError(
+                    f"serve_replicas={dp} needs a grid whose batch (data) "
+                    f"axis is exactly {dp} — build it with "
+                    f"initialize_mesh(batch={dp}, model=...)"
+                )
+            if max_seqs % dp or num_blocks % dp:
+                raise ValueError(
+                    f"max_seqs ({max_seqs}) and num_blocks ({num_blocks}) "
+                    f"must divide into {dp} serve replicas"
+                )
+            if enable_prefix_caching or prefill_chunk or enable_speculation:
+                raise NotImplementedError(
+                    "prefix caching, chunked prefill and speculation are "
+                    "not yet replica-aware: their context-attention packs "
+                    "read the pool through GSPMD gathers that a batch-"
+                    "sharded pool would route cross-replica — run those "
+                    "features with serve_replicas=1 (the multi-replica "
+                    "router PR lifts this)"
+                )
+            # NOTE: the scheduler still CHUNKS a prompt longer than the
+            # largest prefill bucket (and a long preempted requeue) even
+            # with prefill_chunk unset — those continuation packs run
+            # prefill_packed_ctx, whose dense ctx gather crosses the
+            # batch-sharded pool under GSPMD.  Correct (CPU-verified
+            # bit-identical) but not replica-local: keep over-budget
+            # prompts off dp>1 engines where that matters.
+        self.serve_replicas = dp
         # Quantized-weight serving (reference csrc/fp_quantizer + FP6 blog
         # 1.69-2.65x claim): big matmul kernels stored int8/fp8 with per-
         # output-channel scales; serving_mm applies the scale post-matmul so
@@ -88,16 +126,20 @@ class InferenceEngineV2:
         self.quantize_weights = quantize_weights
         if quantize_weights is not None:
             # Quantize BEFORE TP sharding: the AutoTP walk then shards the
-            # compressed payloads (q classifies like its kernel — same path
-            # and trailing dims; scales ride the bias heuristic or
-            # replicate, which under GSPMD only affects layout, never
-            # numerics).  int8 TP serving is the multi-chip 70B capacity
-            # combo (reference: FP6 + TP in inference v2).
+            # compressed payloads (q/packed classify like their kernel —
+            # same path and trailing dims; per-output-channel scales shard
+            # with their column-parallel out dims).  FP6 row-parallel
+            # kernels pack per K-chunk so the byte planes shard cleanly on
+            # in-features (ServingQuantFP6.row_shards).  int8 TP serving is
+            # the multi-chip 70B capacity combo (reference: FP6 + TP in
+            # inference v2).
             from ..ops.quantizer import quantize_serving_params, tree_nbytes
 
             before = tree_nbytes(params)
             params = jax.jit(
-                lambda p: quantize_serving_params(p, quantize_weights)
+                lambda p: quantize_serving_params(
+                    p, quantize_weights, row_parallel_shards=tp
+                )
             )(params)
             log_dist(
                 f"quantized-weight serving ({quantize_weights}): params "
@@ -116,8 +158,7 @@ class InferenceEngineV2:
         # model that trains under zero.Init serves the same way: sharded.
         self.grid = grid
         self._mesh = None
-        tp = grid.spec.model if grid is not None else 1
-        if grid is not None and tp > 1:
+        if grid is not None and (tp > 1 or dp > 1):
             if offload_weights:
                 raise ValueError(
                     "offload_weights and tensor-parallel serving are "
@@ -132,20 +173,17 @@ class InferenceEngineV2:
             import jax.tree_util as jtu
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from ..ops.quantizer import set_fused_serving
             from ..parallel.auto_tp import infer_tp_rules
             from ..runtime.zero import match_rules, path_str
 
-            # fused dequant-matmul has no GSPMD sharding rule: under TP the
-            # partitioner would gather the full weight per shard.  The jnp
-            # serving_mm body partitions cleanly, so TP serving pins it.
-            # (Process-wide switch: engines trace at first dispatch, so a TP
-            # engine in the process keeps later engines on the jnp body too
-            # — correct everywhere, fused perf only matters single-chip.)
-            set_fused_serving(False)
-
             self._mesh = grid.mesh
-            rules = infer_tp_rules(params, tp, vocab_size=cfg.vocab_size)
+            # head-divisibility hints: attention kernels shard at HEAD
+            # granularity only (GQA with hkv < tp replicates wk/wv,
+            # matching the replicated KV pool the paged TP path uses there)
+            rules = infer_tp_rules(
+                params, tp, vocab_size=cfg.vocab_size,
+                num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+            )
             self._param_shardings = jtu.tree_map_with_path(
                 lambda kp, leaf: NamedSharding(
                     grid.mesh, match_rules(path_str(kp), tuple(leaf.shape), rules)
@@ -194,12 +232,31 @@ class InferenceEngineV2:
 
         self.serve = serve if isinstance(serve, ServeConfig) \
             else _coerce(ServeConfig, serve)
+        # per-ENGINE fused-kernel policy (serving_mm ServingContext): the
+        # old process-global set_fused_serving switch let one TP engine pin
+        # every later single-chip engine in the process to the jnp body.
+        # Constructor arg wins; else the serve config block; None = auto
+        # (fused kernel whenever local shapes qualify — including under TP,
+        # where the kernels now run inside manual shard_map regions).
+        self.fused_serving = (fused_serving if fused_serving is not None
+                              else self.serve.fused_serving)
+        from ..ops.quantizer import ServingContext
+        from ..parallel.topology import MODEL_AXIS
+
+        self.serving_ctx = ServingContext(
+            mesh=self._mesh if tp > 1 else None,
+            axis=MODEL_AXIS,
+            size=tp,
+            kv_cols=(cfg.num_kv_heads % tp == 0),
+            fused=self.fused_serving,
+        )
         # chaos harness (inference/faults.py): a seeded FaultInjector whose
         # scoped points fire inside this engine's dispatch sites and the
         # allocator's growth path; None = every check compiles to a no-op
         self.faults = faults
         self.mgr = StateManager(num_blocks, block_size, max_seqs,
-                                enable_prefix_caching=enable_prefix_caching)
+                                enable_prefix_caching=enable_prefix_caching,
+                                replicas=dp)
         self.mgr.faults = faults
         self._scheduler = None
         # telemetry (telemetry/): ``stats`` is now a read-through view over
@@ -254,7 +311,8 @@ class InferenceEngineV2:
         self._h = {
             k: reg.histogram(f"{self._ns}/{k}")
             for k in ("prefill_pack_ms", "decode_tick_ms", "spec_tick_ms",
-                      "burst_tick_ms", "spec_draft_len", "spec_match_distance")
+                      "burst_tick_ms", "spec_draft_len", "spec_match_distance",
+                      "tp_allreduce_ms")
         }
         # eagerly register this engine's request-latency group so the
         # namespace's histograms exist (empty) before any request arrives
@@ -274,7 +332,9 @@ class InferenceEngineV2:
         if self._mesh is not None:
             from jax.sharding import NamedSharding
 
-            kv_sh = NamedSharding(self._mesh, kv_pool_pspec(cfg.num_kv_heads, tp))
+            kv_sh = NamedSharding(
+                self._mesh, kv_pool_pspec(cfg.num_kv_heads, tp, dp)
+            )
             self._kv_shardings = (kv_sh, kv_sh)
             self.kv = jax.device_put(self.kv, self._kv_shardings)
         self._rng = jax.random.PRNGKey(seed)
@@ -296,13 +356,18 @@ class InferenceEngineV2:
         # params are explicit jit arguments — closing over them would inline
         # every weight into the HLO as a constant (huge programs, no donation)
         cfg_ = self.cfg
+        # serving-matmul policy closure: TP mesh + fused-kernel gate for the
+        # shard_map'd quant-matmul regions inside the compiled dispatches
+        ctx_ = self.serving_ctx
+        dp_ = self.serve_replicas
 
         # only the device-relevant sampling triple is static — hashing the
         # whole SamplingParams would recompile on max_new_tokens/stop_token
         def packed_impl(params, tokens, seg, pos, pack_pages, last_idx,
                         kv, rng, sampling_triple):
             logits, kv = model_runner.prefill_packed(
-                params, cfg_, tokens, seg, pos, pack_pages, last_idx, kv
+                params, cfg_, tokens, seg, pos, pack_pages, last_idx, kv,
+                ctx=ctx_,
             )
             # sampling fused into the dispatch: the decode loop never makes a
             # second device round trip per tick.  finite_guard folds NaN/inf
@@ -319,7 +384,7 @@ class InferenceEngineV2:
             continuation chunks).  Cold packs stay on ``packed_impl``."""
             logits, kv = model_runner.prefill_packed_ctx(
                 params, cfg_, tokens, seg, pos, pack_pages, last_idx,
-                ctx_tables, ctx_lens, kv
+                ctx_tables, ctx_lens, kv, ctx=ctx_,
             )
             t, k, p = sampling_triple
             sampled = sample(logits, SamplingParams(t, k, p), rng)
@@ -344,7 +409,7 @@ class InferenceEngineV2:
             dispatch call itself (the tunnel-RTT killer, r4 VERDICT weak #1)."""
             logits, kv = model_runner.decode_step(
                 params, cfg_, tokens, seq_lens, block_tables, active, kv,
-                mesh=mesh_,
+                ctx=ctx_, mesh=mesh_, dp=dp_,
             )
             t, k, p = sampling_triple
             rng, sub = jax.random.split(rng)
@@ -381,7 +446,7 @@ class InferenceEngineV2:
 
             logits, kv = model_runner.verify_packed_ctx(
                 params, cfg_, tokens, seg, pos, dst_pages, dst_offs,
-                ctx_tables, ctx_lens, kv,
+                ctx_tables, ctx_lens, kv, ctx=ctx_,
             )
             k1 = draft.shape[1] + 1
             logits = logits.reshape(draft.shape[0], k1, -1)
@@ -401,6 +466,12 @@ class InferenceEngineV2:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             rep = NamedSharding(self._mesh, P())
+            # donated per-tick inputs (seq_lens, rng, burst buffers) must be
+            # COMMITTED to the replicated sharding their pinned outputs
+            # carry: left uncommitted, GSPMD may choose a batch-sharded
+            # input layout (it propagates the 2-D mesh attention specs) and
+            # the donor/output aliasing then fails on the size mismatch
+            self._rep_sharding = rep
             self._packed_prefill_jit = jax.jit(
                 packed_impl, donate_argnums=(6,), static_argnums=(8,),
                 out_shardings=(rep, self._kv_shardings),
@@ -569,7 +640,10 @@ class InferenceEngineV2:
                 functools.partial(init_params, cfg=cfg, dtype=cfg.dtype),
                 jax.random.PRNGKey(0),
             )
-            rules = infer_tp_rules(shapes, grid.spec.model, vocab_size=cfg.vocab_size)
+            rules = infer_tp_rules(
+                shapes, grid.spec.model, vocab_size=cfg.vocab_size,
+                num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads,
+            )
             plan = plan_sharding(shapes, ZeroConfig(stage=0), grid.spec, tp_rules=rules)
             params, cfg = load_hf_checkpoint_sharded(
                 model_dir, plan, grid.mesh, cfg=cfg, dtype=cfg.dtype, store=store
@@ -589,11 +663,10 @@ class InferenceEngineV2:
         return cls(params, cfg, **kw)
 
     def can_schedule(self, prompt_lens: Sequence[int]) -> bool:
-        blocks = sum(-(-p // self.block_size) for p in prompt_lens)
-        return (
-            len(self.mgr.seqs) + len(prompt_lens) <= self.mgr.max_seqs
-            and blocks <= self.mgr.allocator.available_blocks
-        )
+        # replica-aware: aggregate block counts would accept a batch that
+        # fits the SUM of the per-replica pools but no single replica —
+        # the simulation mirrors admit's sequential placement exactly
+        return self.mgr.can_admit_all(prompt_lens)
 
     # -- serving API -------------------------------------------------------
     def put(
@@ -631,10 +704,23 @@ class InferenceEngineV2:
                 "out of KV blocks/slots"
             )
         entries = []
-        for uid, toks in zip(uids, token_lists):
-            seq = self.mgr.admit(uid, toks)
-            self.mgr.ensure_capacity(seq, 0)
-            entries.append((seq, seq.seen_tokens, len(seq.tokens)))
+        admitted: List[int] = []
+        pt, ct = self.mgr.prompt_tokens_total, self.mgr.cached_prompt_tokens
+        try:
+            for uid, toks in zip(uids, token_lists):
+                seq = self.mgr.admit(uid, toks)
+                admitted.append(uid)
+                self.mgr.ensure_capacity(seq, 0)
+                entries.append((seq, seq.seen_tokens, len(seq.tokens)))
+        except RuntimeError:
+            # keep the all-or-nothing contract even if a replica's pool
+            # defeats the pre-check (e.g. racing chaos injection): nothing
+            # stays admitted with never-written KV pages
+            for u in admitted:
+                self.mgr.release(u)
+            self.mgr.prompt_tokens_total = pt
+            self.mgr.cached_prompt_tokens = ct
+            raise
         return self.prefill_entries(entries, sampling)
 
     def prefill_entries(self, entries, sampling: SamplingParams) -> Dict[int, int]:
@@ -809,6 +895,78 @@ class InferenceEngineV2:
             self._samp_dev = jnp.array(self._samp_np)
             self._c["sampling_uploads"].inc()
         return self._samp_dev
+
+    def _commit_rep(self, x):
+        """Upload/commit ``x`` replicated on the mesh (identity transfer on
+        single-device engines).  Required for arrays the decode jits DONATE:
+        their outputs are pinned replicated, so the donated input must be
+        committed to the same layout (see ``_rep_sharding``)."""
+        if self._mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(x, self._rep_sharding)
+
+    def measure_tp_collectives(self, reps: int = 8) -> Optional[float]:
+        """Microbenchmark THIS engine's per-decode-tick TP collective cost
+        at the served shapes — the sequential row-parallel ``psum`` chain
+        (two per layer: o-projection + down-projection partial products,
+        [B, hidden] fp32 each) plus the vocab-sharded logits all-gather —
+        and observe every rep into the ``serve/tp_allreduce_ms`` histogram
+        with a span on the engine's trace track.
+
+        This is the cost the quantized-collectives work must attack, so it
+        is MEASURED here rather than guessed from link rooflines.  Explicit
+        call (bench ``--serve8b --tp N`` runs it; it is not part of the
+        decode hot path — a per-tick in-graph measurement would perturb the
+        tick it measures).  Returns the median ms, or None without a TP
+        mesh."""
+        import time as _time
+
+        if self._mesh is None or self.serving_ctx.size <= 1:
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sharding import shard_map_compat
+        from ..parallel.topology import MODEL_AXIS
+
+        cfg, tp = self.cfg, self.serving_ctx.size
+        B, d, L = self.mgr.max_seqs, cfg.hidden_size, cfg.num_layers
+        v = (cfg.vocab_size // tp) * tp  # sharded-head rows, pad-free
+        n_red = 2 * L
+
+        def body(xs, lg):
+            def step(c, x):
+                # the carry feeds each psum's operand, so XLA cannot fuse
+                # the chain into one batched collective — a decode tick
+                # issues its row-parallel reductions sequentially too
+                c = c + jax.lax.psum(x + 0.0 * c, MODEL_AXIS)
+                return c, jnp.float32(0)
+            c, _ = jax.lax.scan(step, jnp.zeros_like(xs[0]), xs)
+            full = jax.lax.all_gather(lg, MODEL_AXIS, axis=1, tiled=True)
+            return c, full
+
+        f = jax.jit(shard_map_compat(
+            body, self._mesh,
+            in_specs=(P(None, None, None), P(None, MODEL_AXIS)),
+            out_specs=(P(None, None), P(None, None)),
+        ))
+        xs = jnp.zeros((n_red, B, d), jnp.float32)
+        lg = jnp.zeros((B, v), jnp.float32)
+        jax.block_until_ready(f(xs, lg))  # compile outside the window
+        times = []
+        for _ in range(reps):
+            sp = self.telemetry.recorder.start(
+                "tp_allreduce", track=self._ns,
+                hist=self._h["tp_allreduce_ms"],
+                reductions=n_red, gather_rows=v, tp=tp,
+            )
+            t0 = _time.perf_counter()
+            out = f(xs, lg)
+            sp.dispatched()
+            jax.block_until_ready(out)
+            times.append(1e3 * (_time.perf_counter() - t0))
+            sp.end()
+        times.sort()
+        return times[len(times) // 2]
 
     # -- fault hooks ---------------------------------------------------------
     def _maybe_fault(self, point: str, uids) -> None:
@@ -1053,9 +1211,10 @@ class InferenceEngineV2:
             "decode_tick", self._c["decode_ticks"].value + 1
         ):
             sampled, _, _, self.kv = self._decode_jit(
-                self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+                self.params, jnp.asarray(tokens), self._commit_rep(seq_lens),
                 self._tables_device(), jnp.asarray(active), self.kv,
-                sub, (sampling.temperature, sampling.top_k, sampling.top_p),
+                self._commit_rep(sub),
+                (sampling.temperature, sampling.top_k, sampling.top_p),
             )
         sp.dispatched()
         self._c["decode_ticks"].inc()
@@ -1171,17 +1330,18 @@ class InferenceEngineV2:
         # buffer accumulates rows on device and is fetched once.
         tables = self._tables_device()
         active_j = jnp.asarray(active)
-        tokens_dev = jnp.asarray(tokens0)
-        lens_dev = jnp.asarray(base_lens)
+        tokens_dev = self._commit_rep(tokens0)
+        lens_dev = self._commit_rep(base_lens)
         self._rng, key_dev = jax.random.split(self._rng)
+        key_dev = self._commit_rep(key_dev)
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         # fixed burst capacity -> one compiled program for every n
         cap = self._burst_cap
         while cap < n:
             cap *= 2
         self._burst_cap = cap
-        burst_dev = jnp.zeros((cap, B), jnp.int32)
-        tick_dev = jnp.zeros((), jnp.int32)
+        burst_dev = self._commit_rep(np.zeros((cap, B), np.int32))
+        tick_dev = self._commit_rep(np.zeros((), np.int32))
         # ONE span for the whole burst — per-tick spans would retain one
         # device array per tick, the exact host-reference leak step_n's
         # design removes (14 ms -> 20-70 ms ticks measured); the per-tick

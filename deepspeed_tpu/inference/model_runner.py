@@ -38,16 +38,24 @@ from .paged import (
 Params = Any
 
 
-def _qkv(lw, x, cfg: TransformerConfig):
+def _qkv(lw, x, cfg: TransformerConfig, ctx=None):
     b, s, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.hd
     # serving_mm: transparent over quantized-weight serving (ServingQuant);
     # biases ride the call so the fused dequant-matmul kernel folds them
     # into its fp32 epilogue (on the jnp body they add post-cast, exactly
-    # as before)
-    q = serving_mm(x, lw["wq"], lw.get("bq") if cfg.qkv_bias else None)
-    k = serving_mm(x, lw["wk"], lw.get("bk") if cfg.qkv_bias else None)
-    v = serving_mm(x, lw["wv"], lw.get("bv") if cfg.qkv_bias else None)
+    # as before).  Under a TP mesh (``ctx``) q/k/v are column-parallel —
+    # out-features (whole heads) sharded on the model axis, no collective —
+    # except that wk/wv stay replicated compute ('rep') when the kv-head
+    # count doesn't divide the axis (GQA, hkv < tp): sub-head sharding is
+    # never produced, matching the replicated KV pool in that regime.
+    kv_kind = "col" if (ctx is None or ctx.kv_cols) else "rep"
+    q = serving_mm(x, lw["wq"], lw.get("bq") if cfg.qkv_bias else None,
+                   kind="col", ctx=ctx)
+    k = serving_mm(x, lw["wk"], lw.get("bk") if cfg.qkv_bias else None,
+                   kind=kv_kind, ctx=ctx)
+    v = serving_mm(x, lw["wv"], lw.get("bv") if cfg.qkv_bias else None,
+                   kind=kv_kind, ctx=ctx)
     return (
         q.reshape(b, s, hq, hd),
         k.reshape(b, s, hkv, hd),
@@ -55,7 +63,7 @@ def _qkv(lw, x, cfg: TransformerConfig):
     )
 
 
-def _ffn(lw, x, cfg):
+def _ffn(lw, x, cfg, ctx=None):
     if cfg.moe_num_experts > 0:
         # dropless at inference: capacity competition would make routing
         # depend on batch padding (moe/layer.py moe_block_dropless)
@@ -65,24 +73,33 @@ def _ffn(lw, x, cfg):
         return out
     mlp = lw["mlp"]
     act = _activation(cfg.activation)
-    # gpt2/opt/phi-style biased MLP: biases fuse into the serving matmul
-    up = serving_mm(x, mlp["w_up"], mlp.get("b_up"))
+    # gpt2/opt/phi-style biased MLP: biases fuse into the serving matmul.
+    # TP placement is the Megatron pair: up/gate column-parallel (sharded
+    # activations feed the elementwise gate locally), down row-parallel
+    # (one psum on the partial products, bias added once post-reduce).
+    up = serving_mm(x, mlp["w_up"], mlp.get("b_up"), kind="col", ctx=ctx)
     if cfg.gated_mlp:
-        gate = serving_mm(x, mlp["w_gate"], mlp.get("b_gate"))
+        gate = serving_mm(x, mlp["w_gate"], mlp.get("b_gate"), kind="col",
+                          ctx=ctx)
         h = act(gate) * up
     else:
         h = act(up)
-    return serving_mm(h, mlp["w_down"], mlp.get("b_down"))
+    return serving_mm(h, mlp["w_down"], mlp.get("b_down"), kind="row", ctx=ctx)
 
 
-def _attn_out(lw, x):
-    """o-projection (+ bias when the family carries one)."""
-    return serving_mm(x, lw["wo"], lw.get("bo"))
+def _attn_out(lw, x, ctx=None):
+    """o-projection (+ bias when the family carries one).  Row-parallel
+    under TP: the head-sharded attention output is exactly the in-feature
+    sharding the region wants — qkv->attention->o costs ONE psum total."""
+    return serving_mm(x, lw["wo"], lw.get("bo"), kind="row", ctx=ctx)
 
 
-def _lm_logits(params, cfg, x):
-    """Final head (+ gptj/phi lm_head bias) in fp32."""
-    logits = serving_mm(x, head_kernel(params, cfg), head_bias_vec(params))
+def _lm_logits(params, cfg, x, ctx=None):
+    """Final head (+ gptj/phi lm_head bias) in fp32.  Vocab-sharded
+    column-parallel under TP; the consumer (sampling argmax / gather)
+    decides whether GSPMD materializes the full-vocab row."""
+    logits = serving_mm(x, head_kernel(params, cfg), head_bias_vec(params),
+                        kind="col", ctx=ctx)
     return logits.astype(jnp.float32)
 
 
@@ -100,6 +117,7 @@ def prefill(
     length: jnp.ndarray,  # scalar — true prompt length
     blocks: jnp.ndarray,  # [n_pages] int32, -1 padded
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+    ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
 ):
     """Run the prompt, write its KV pages, return (logits_at_last, caches).
 
@@ -121,7 +139,7 @@ def prefill(
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
-        q, k, v = _qkv(lw["attn"], h, cfg)
+        q, k, v = _qkv(lw["attn"], h, cfg, ctx)
         if cfg.position == "rope":
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
@@ -137,14 +155,14 @@ def prefill(
         attn = flash_attention(
             q, k, v, causal=True, logits_soft_cap=cfg.logits_soft_cap
         )
-        attn = _attn_out(lw["attn"], attn.reshape(1, s, -1))
+        attn = _attn_out(lw["attn"], attn.reshape(1, s, -1), ctx)
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
-        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+        x = x + _ffn(lw, h, cfg, ctx).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(length - 1, 0, s - 1)]  # [d]
-    logits = _lm_logits(params, cfg, last)  # [v]
+    logits = _lm_logits(params, cfg, last, ctx)  # [v]
     return logits, (tuple(new_ck), tuple(new_cv))
 
 
@@ -157,6 +175,7 @@ def prefill_packed(
     pack_pages: jnp.ndarray,  # [T/bs] int32 — destination page per bs-chunk (-1 pad)
     last_idx: jnp.ndarray,  # [N] int32 — buffer index of each prompt's last token (-1 pad)
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+    ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
 ):
     """Batched multi-prompt prefill under one token budget (the Dynamic
     SplitFuse-shaped dispatch; reference ``inference/v2/ragged/
@@ -190,7 +209,7 @@ def prefill_packed(
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
-        q, k, v = _qkv(lw["attn"], h, cfg)
+        q, k, v = _qkv(lw["attn"], h, cfg, ctx)
         if cfg.position == "rope":
             q = rope(q, pos2, cfg.rope_theta)
             k = rope(k, pos2, cfg.rope_theta)
@@ -210,14 +229,14 @@ def prefill_packed(
             q, k, v, causal=True, segment_ids=seg,
             logits_soft_cap=cfg.logits_soft_cap,
         )
-        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
+        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
-        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+        x = x + _ffn(lw, h, cfg, ctx).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
-    logits = _lm_logits(params, cfg, last)  # [N, v]
+    logits = _lm_logits(params, cfg, last, ctx)  # [N, v]
     return logits, (tuple(new_ck), tuple(new_cv))
 
 
@@ -232,6 +251,7 @@ def prefill_packed_ctx(
     ctx_tables: jnp.ndarray,  # [N, P] int32 — block table per segment (-1 pad)
     ctx_lens: jnp.ndarray,  # [N] int32 — cached-context length per segment
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+    ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
 ):
     """``prefill_packed`` generalized to token SUFFIXES: each packed segment
     starts at a per-sequence offset (``ctx_lens``) and attends over its
@@ -262,7 +282,7 @@ def prefill_packed_ctx(
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
-        q, k, v = _qkv(lw["attn"], h, cfg)
+        q, k, v = _qkv(lw["attn"], h, cfg, ctx)
         if cfg.position == "rope":
             q = rope(q, pos2, cfg.rope_theta)
             k = rope(k, pos2, cfg.rope_theta)
@@ -281,14 +301,14 @@ def prefill_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
         )
-        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
+        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
-        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+        x = x + _ffn(lw, h, cfg, ctx).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
     last = x[0, jnp.clip(last_idx, 0, t - 1)]  # [N, d]
-    logits = _lm_logits(params, cfg, last)  # [N, v]
+    logits = _lm_logits(params, cfg, last, ctx)  # [N, v]
     return logits, (tuple(new_ck), tuple(new_cv))
 
 
@@ -303,6 +323,7 @@ def verify_packed_ctx(
     ctx_tables: jnp.ndarray,  # [N, P] int32 — block table per slot (-1 pad)
     ctx_lens: jnp.ndarray,  # [N] int32 — committed (KV-written) length per slot
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+    ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
 ):
     """Speculative-decode verify: score k+1 positions per sequence in ONE
     pass — the dispatch that amortizes the weight stream across several
@@ -341,7 +362,7 @@ def verify_packed_ctx(
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
-        q, k, v = _qkv(lw["attn"], h, cfg)
+        q, k, v = _qkv(lw["attn"], h, cfg, ctx)
         if cfg.position == "rope":
             q = rope(q, pos2, cfg.rope_theta)
             k = rope(k, pos2, cfg.rope_theta)
@@ -354,13 +375,13 @@ def verify_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
         )
-        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1))
+        attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
-        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+        x = x + _ffn(lw, h, cfg, ctx).astype(x.dtype)
 
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    logits = _lm_logits(params, cfg, x[0])  # [T, v]
+    logits = _lm_logits(params, cfg, x[0], ctx)  # [T, v]
     return logits, (tuple(new_ck), tuple(new_cv))
 
 
@@ -372,7 +393,9 @@ def decode_step(
     block_tables: jnp.ndarray,  # [B, P] int32
     active: jnp.ndarray,  # [B] bool
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
+    ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
     mesh=None,  # TP serving: shard_map the paged attention over 'model'
+    dp: int = 1,  # batch-axis replicas (2-D batch x model serve mesh)
 ):
     """One batched decode tick: returns (logits [B, v], new caches)."""
     b = tokens.shape[0]
@@ -389,7 +412,7 @@ def decode_step(
     for l in range(cfg.num_layers):
         lw = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         h = norm(x, lw["attn_norm"], cfg.norm, cfg.norm_eps)
-        q, k, v = _qkv(lw["attn"], h, cfg)  # [B,1,h,hd]
+        q, k, v = _qkv(lw["attn"], h, cfg, ctx)  # [B,1,h,hd]
         if cfg.position == "rope":
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
@@ -401,12 +424,12 @@ def decode_step(
         )
         attn = paged_attention_decode(
             q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
-            logits_soft_cap=cfg.logits_soft_cap, mesh=mesh,
+            logits_soft_cap=cfg.logits_soft_cap, mesh=mesh, dp=dp,
         )
-        attn = _attn_out(lw["attn"], attn.reshape(b, 1, -1))
+        attn = _attn_out(lw["attn"], attn.reshape(b, 1, -1), ctx)
         x = x + attn.astype(x.dtype)
         h = norm(x, lw["mlp_norm"], cfg.norm, cfg.norm_eps)
-        x = x + _ffn(lw, h, cfg).astype(x.dtype)
+        x = x + _ffn(lw, h, cfg, ctx).astype(x.dtype)
     x = norm(x, params["final_norm"], cfg.norm, cfg.norm_eps)
-    logits = _lm_logits(params, cfg, x[:, 0])
+    logits = _lm_logits(params, cfg, x[:, 0], ctx)
     return logits, (tuple(new_ck), tuple(new_cv))

@@ -160,7 +160,7 @@ def paged_attention_packed_ctx(
 
 def paged_attention_decode(
     q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
-    logits_soft_cap=None, mesh=None,
+    logits_soft_cap=None, mesh=None, dp: int = 1,
 ):
     """Single-token attention against paged KV.
 
@@ -180,11 +180,17 @@ def paged_attention_decode(
     replicated when hkv doesn't divide the axis).  A Pallas call cannot be
     partitioned by GSPMD — without the explicit map XLA would all-gather the
     whole block pool to every shard.
+
+    ``dp > 1`` (the 2-D batch×model serve mesh): the region additionally
+    shards the BATCH axis — slot rows of q/tables/lens and the BLOCK dim of
+    the pool — and each replica translates its rows' global block ids into
+    its local pool range (the engine's slot/block partitioning guarantees a
+    replica's sequences only ever hold blocks from its own range).
     """
-    if mesh is not None and _model_axis_size(mesh) > 1:
+    if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1):
         return _paged_attention_decode_tp(
             q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh,
-            scale=scale, logits_soft_cap=logits_soft_cap,
+            dp=dp, scale=scale, logits_soft_cap=logits_soft_cap,
         )
     return _paged_attention_decode_local(
         q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=scale,
@@ -215,57 +221,59 @@ def _model_axis_size(mesh) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(MODEL_AXIS, 1)
 
 
-def kv_pool_pspec(num_kv_heads: int, tp: int):
-    """PartitionSpec for a [L, nb, bs, hkv, hd] block pool: kv heads shard on
-    ``model`` when divisible, otherwise the pool replicates (GQA, hkv < tp)."""
+def kv_pool_pspec(num_kv_heads: int, tp: int, dp: int = 1):
+    """PartitionSpec for a per-layer [nb, bs, hkv, hd] block pool: kv heads
+    shard on ``model`` when divisible, otherwise the pool replicates (GQA,
+    hkv < tp).  ``dp > 1`` (batch×model serve mesh) additionally shards the
+    BLOCK dim over ``batch`` — each serving replica owns a contiguous block
+    range, so pool capacity scales with the batch axis."""
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.topology import MODEL_AXIS
+    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS
 
     head_axis = MODEL_AXIS if (tp > 1 and num_kv_heads % tp == 0) else None
+    block_axis = BATCH_AXIS if dp > 1 else None
     # per-LAYER pool arrays [nb, bs, hkv, hd] (init_paged_cache)
-    return P(None, None, head_axis, None)
+    return P(block_axis, None, head_axis, None)
 
 
 def _paged_attention_decode_tp(
-    q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh, scale=None,
-    logits_soft_cap=None,
+    q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh, dp=1,
+    scale=None, logits_soft_cap=None,
 ):
     import functools
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.topology import MODEL_AXIS
-
-    try:
-        from jax import shard_map as _sm  # jax >= 0.8 (check_vma kwarg)
-
-        def shard_map(f, **kw):
-            kw["check_vma"] = kw.pop("check_rep")
-            return _sm(f, **kw)
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from ..parallel.sharding import shard_map_compat
+    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS
 
     tp = _model_axis_size(mesh)
     b, hq, hd = q.shape
     hkv = cache_k_layer.shape[2]
-    if hq % tp != 0:
+    if tp > 1 and hq % tp != 0:
         raise ValueError(
             f"model axis ({tp}) must divide num_heads ({hq}) for TP serving"
         )
-    kv_sharded = hkv % tp == 0
+    if dp > 1 and b % dp != 0:
+        raise ValueError(
+            f"batch axis ({dp}) must divide the slot count ({b})"
+        )
+    kv_sharded = tp > 1 and hkv % tp == 0
     kv_head_axis = MODEL_AXIS if kv_sharded else None
-    q_spec = P(None, MODEL_AXIS, None)
-    kv_spec = P(None, None, kv_head_axis, None)
+    head_axis = MODEL_AXIS if tp > 1 else None
+    batch_axis = BATCH_AXIS if dp > 1 else None
+    q_spec = P(batch_axis, head_axis, None)
+    kv_spec = P(batch_axis, None, kv_head_axis, None)
     local = functools.partial(
         _paged_attention_decode_local, scale=scale, logits_soft_cap=logits_soft_cap
     )
-    if kv_sharded:
+    if kv_sharded or tp == 1:
         # hq/hkv is integral, so the kv heads of q shard i are exactly kv
         # shard i — local GQA ratio is preserved and no gather is needed
-        body = local
+        inner = local
     else:
-        def body(q_l, ck, cv, bt, sl):
+        def inner(q_l, ck, cv, bt, sl):
             # replicated pool (hkv < tp): each shard narrows the pool to its
             # q heads' kv head(s) so the local body sees an aligned GQA
             # problem — repeat_kv(hq_local // hkv) would be 0 when
@@ -289,10 +297,21 @@ def _paged_attention_decode_tp(
             return local(q_l, _jnp.take(ck, kv_ids, axis=2),
                          _jnp.take(cv, kv_ids, axis=2), bt, sl)
 
-    return shard_map(
-        body, mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, P(None, None), P(None)),
-        out_specs=q_spec, check_rep=False,
+    def body(q_l, ck, cv, bt, sl):
+        if dp > 1:
+            # each batch replica's table rows carry GLOBAL block ids inside
+            # its own contiguous range (the allocator partitions the pool);
+            # the local pool slice starts at r * nb_local, so ids translate
+            # by a constant offset.  -1 padding stays out of range and is
+            # masked by seq_lens, exactly like the single-replica body.
+            r = jax.lax.axis_index(BATCH_AXIS)
+            bt = jnp.where(bt >= 0, bt - r * ck.shape[0], -1)
+        return inner(q_l, ck, cv, bt, sl)
+
+    return shard_map_compat(
+        body, mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P(batch_axis, None), P(batch_axis)),
+        out_specs=q_spec,
     )(q, cache_k_layer, cache_v_layer, block_table, seq_lens)
 
 

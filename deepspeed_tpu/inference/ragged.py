@@ -52,11 +52,17 @@ class BlockedAllocator:
     ``available_blocks`` (free + evictable cached).
     """
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, start: int = 0):
         if num_blocks < 1:
             raise ValueError(f"need at least one block, got {num_blocks}")
+        # ``start``: first GLOBAL block id this allocator owns.  Replica-
+        # partitioned pools (2-D batch x model serve mesh) run one allocator
+        # per contiguous range so block ids stay global — device block
+        # tables and prefix-cache keys never need translation host-side.
+        self._start = start
         self._num_blocks = num_blocks
-        self._free: List[int] = list(range(num_blocks))
+        self._free: List[int] = list(range(start, start + num_blocks))
+        # indexed by (block - start): ids stay global, storage stays local
         self._refs: List[int] = [0] * num_blocks
         self._key_of: Dict[int, object] = {}  # block -> content key
         self._by_key: Dict[object, int] = {}  # content key -> block
@@ -84,10 +90,11 @@ class BlockedAllocator:
         return self._num_blocks
 
     def refcount(self, block: int) -> int:
-        return self._refs[block]
+        self._check(block)
+        return self._refs[block - self._start]
 
     def _check(self, block: int) -> None:
-        if not 0 <= block < self._num_blocks:
+        if not self._start <= block < self._start + self._num_blocks:
             raise ValueError(f"invalid block id {block}")
 
     def allocate(self, n: int) -> List[int]:
@@ -101,7 +108,7 @@ class BlockedAllocator:
                 b = self._free.pop()  # LIFO: O(1), and recently-freed pages
             else:  # are the warmest
                 b = self._evict_one()
-            self._refs[b] = 1
+            self._refs[b - self._start] = 1
             out.append(b)
         return out
 
@@ -128,18 +135,18 @@ class BlockedAllocator:
             stack.extend(self._children.pop(x, ()))
             # a de-keyed refcount-0 descendant is dead cache: straight to
             # the free list (the root itself is the caller's to hand out)
-            if x != root and self._refs[x] == 0 and x in self._lru:
+            if x != root and self._refs[x - self._start] == 0 and x in self._lru:
                 del self._lru[x]
                 self._free.append(x)
 
     def ref(self, block: int) -> None:
         """Take a reference on an allocated or cached block."""
         self._check(block)
-        if self._refs[block] == 0:
+        if self._refs[block - self._start] == 0:
             if block not in self._lru:
                 raise ValueError(f"cannot ref free block {block}")
             del self._lru[block]  # revive from the cache
-        self._refs[block] += 1
+        self._refs[block - self._start] += 1
 
     def free(self, blocks: List[int]) -> None:
         """Drop one reference per block; last reference retires the block to
@@ -152,11 +159,11 @@ class BlockedAllocator:
             # count duplicates within THIS call too: validating all entries
             # before any decrement would let free([b, b]) at refcount 1
             # slip past and drive the refcount negative
-            if self._refs[b] < n:
+            if self._refs[b - self._start] < n:
                 raise ValueError(f"double free of block {b}")
         for b in blocks:
-            self._refs[b] -= 1
-            if self._refs[b] == 0:
+            self._refs[b - self._start] -= 1
+            if self._refs[b - self._start] == 0:
                 if b in self._key_of:
                     self._lru[b] = None
                     self._lru.move_to_end(b)
@@ -168,7 +175,7 @@ class BlockedAllocator:
         chained under ``parent`` for eviction cascading.  First writer wins:
         a duplicate key keeps the existing mapping."""
         self._check(block)
-        if self._refs[block] <= 0:
+        if self._refs[block - self._start] <= 0:
             raise ValueError(f"cannot register unowned block {block}")
         if block in self._key_of or key in self._by_key:
             return False
@@ -192,7 +199,7 @@ class BlockedAllocator:
         holding references keep them (they fail on their own logits)."""
         self._check(block)
         self._drop_key(block)
-        if self._refs[block] == 0 and block in self._lru:
+        if self._refs[block - self._start] == 0 and block in self._lru:
             # a de-keyed block is dead cache: straight to the free list
             # (audit forbids unkeyed blocks in the LRU)
             del self._lru[block]
@@ -205,14 +212,15 @@ class BlockedAllocator:
     def audit(self) -> None:
         """Invariant check for tests: every block is in exactly one of
         {free, cached LRU, active (refcount > 0)} and the key maps agree."""
+        owned = range(self._start, self._start + self._num_blocks)
         free = set(self._free)
         lru = set(self._lru)
-        active = {b for b in range(self._num_blocks) if self._refs[b] > 0}
+        active = {b for b in owned if self._refs[b - self._start] > 0}
         assert not (free & lru), f"free/lru overlap: {free & lru}"
         assert not (free & active), f"free/active overlap: {free & active}"
         assert not (lru & active), f"lru/active overlap: {lru & active}"
-        assert free | lru | active == set(range(self._num_blocks)), "leaked blocks"
-        assert all(self._refs[b] == 0 for b in free | lru)
+        assert free | lru | active == set(owned), "leaked blocks"
+        assert all(self._refs[b - self._start] == 0 for b in free | lru)
         for b, key in self._key_of.items():
             assert self._by_key.get(key) == b
         for key, b in self._by_key.items():
@@ -254,6 +262,55 @@ class SequenceDescriptor:
         return len(self.tokens)
 
 
+class _AllocatorGroupView:
+    """Aggregate read view over the per-replica allocators of a partitioned
+    pool (``StateManager(replicas > 1)``) — keeps every pre-existing
+    ``mgr.allocator`` consumer (admission headroom, leak audits, cache-
+    version stamps) working unchanged.  Mutations go through the owning
+    replica's allocator (``StateManager._alloc_of``), never this view."""
+
+    def __init__(self, allocators: List[BlockedAllocator]):
+        self._allocators = allocators
+        self._per = allocators[0].total_blocks
+
+    def _of(self, block: int) -> BlockedAllocator:
+        return self._allocators[block // self._per]
+
+    @property
+    def free_blocks(self) -> int:
+        return sum(a.free_blocks for a in self._allocators)
+
+    @property
+    def cached_blocks(self) -> int:
+        return sum(a.cached_blocks for a in self._allocators)
+
+    @property
+    def available_blocks(self) -> int:
+        return sum(a.available_blocks for a in self._allocators)
+
+    @property
+    def total_blocks(self) -> int:
+        return sum(a.total_blocks for a in self._allocators)
+
+    @property
+    def evictions(self) -> int:
+        return sum(a.evictions for a in self._allocators)
+
+    @property
+    def registrations(self) -> int:
+        return sum(a.registrations for a in self._allocators)
+
+    def refcount(self, block: int) -> int:
+        return self._of(block).refcount(block)
+
+    def key_of(self, block: int):
+        return self._of(block).key_of(block)
+
+    def audit(self) -> None:
+        for a in self._allocators:
+            a.audit()
+
+
 class StateManager:
     """Owns the allocator + uid->descriptor map and the block arithmetic
     (reference: ragged_manager.py DSStateManager).
@@ -268,12 +325,38 @@ class StateManager:
     """
 
     def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
-                 enable_prefix_caching: bool = False):
+                 enable_prefix_caching: bool = False, replicas: int = 1):
+        # ``replicas`` (2-D batch x model serve mesh): slots AND blocks
+        # partition into ``replicas`` contiguous groups — group r's slots
+        # only ever hold blocks from group r's range, so the device pool
+        # can shard its block dim over the batch axis and each mesh replica
+        # resolves its rows' block ids inside its local pool slice.
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if num_blocks % replicas or max_seqs % replicas:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) and max_seqs ({max_seqs}) must "
+                f"both divide into {replicas} serve replicas"
+            )
         self.block_size = block_size
-        self.allocator = BlockedAllocator(num_blocks)
+        self.replicas = replicas
+        self._blocks_per = num_blocks // replicas
+        self._slots_per = max_seqs // replicas
+        self.allocators = [
+            BlockedAllocator(self._blocks_per, start=r * self._blocks_per)
+            for r in range(replicas)
+        ]
+        # single-replica managers expose the one allocator object unchanged
+        # (the overwhelmingly common case and every pre-existing caller);
+        # replica-partitioned managers expose an aggregate read view
+        self.allocator = (self.allocators[0] if replicas == 1
+                          else _AllocatorGroupView(self.allocators))
         self.max_seqs = max_seqs
         self.seqs: Dict[int, SequenceDescriptor] = {}
-        self._free_slots = list(range(max_seqs))
+        self._slot_groups = [
+            list(range(r * self._slots_per, (r + 1) * self._slots_per))
+            for r in range(replicas)
+        ]
         self.enable_prefix_caching = enable_prefix_caching
         self.cow_hook: Optional[Callable[[int, int], None]] = None
         # chaos-harness hook (inference/faults.py FaultInjector): when set,
@@ -287,7 +370,51 @@ class StateManager:
 
     @property
     def free_slots(self) -> int:
-        return len(self._free_slots)
+        return sum(len(g) for g in self._slot_groups)
+
+    def replica_of(self, seq: SequenceDescriptor) -> int:
+        return seq.slot // self._slots_per
+
+    def _alloc_of(self, seq: SequenceDescriptor) -> BlockedAllocator:
+        return self.allocators[self.replica_of(seq)]
+
+    def _pick_replica(self, prompt_len: int) -> Optional[int]:
+        """Admission placement: among replica groups with a free slot, the
+        one with the most immediately-allocatable blocks that can fit the
+        prompt (None when nobody fits) — the scheduler's per-replica batch
+        balancing rides on this single decision point."""
+        blocks = -(-prompt_len // self.block_size)
+        best, best_avail = None, -1
+        for r in range(self.replicas):
+            if not self._slot_groups[r]:
+                continue
+            avail = self.allocators[r].available_blocks
+            if avail >= blocks and avail > best_avail:
+                best, best_avail = r, avail
+        return best
+
+    def can_admit_all(self, prompt_lens) -> bool:
+        """Whether ALL prompts can be admitted together: a greedy simulation
+        of the sequential per-replica placement ``admit`` performs (most-
+        headroom replica with a free slot that fits, in submission order).
+        Aggregate-pool arithmetic is NOT sufficient under replicas — a
+        prompt can fit the sum of two half-empty pools while fitting
+        neither — and the engine's all-or-nothing ``put()`` contract needs
+        the answer BEFORE the first admission mutates anything."""
+        slots = [len(g) for g in self._slot_groups]
+        avail = [a.available_blocks for a in self.allocators]
+        for n in prompt_lens:
+            blocks = -(-int(n) // self.block_size)
+            best = -1
+            for r in range(self.replicas):
+                if slots[r] and avail[r] >= blocks \
+                        and (best < 0 or avail[r] > avail[best]):
+                    best = r
+            if best < 0:
+                return False
+            slots[best] -= 1
+            avail[best] -= blocks
+        return True
 
     def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
         have = len(seq.blocks) * self.block_size
@@ -295,26 +422,31 @@ class StateManager:
         return max(0, -(-(need - have) // self.block_size))
 
     def can_admit(self, prompt_len: int) -> bool:
-        blocks = -(-prompt_len // self.block_size)
-        return bool(self._free_slots) and blocks <= self.allocator.available_blocks
+        return self._pick_replica(prompt_len) is not None
 
-    def _match_prefix(self, tokens: List[int]) -> Tuple[List[int], List[object]]:
+    def _match_prefix(
+        self, tokens: List[int], allocator: Optional[BlockedAllocator] = None
+    ) -> Tuple[List[int], List[object]]:
         """Longest cached run of FULL leading blocks for ``tokens``.  Capped
         at ``(len-1)//block_size`` blocks so at least the final prompt token
         is always recomputed (its logits are needed, and its KV write must
         land in a page this sequence owns — never a shared one).  The walk
         chains each key on the MATCHED parent block's id, so every hop is an
-        exact-content match (see ``block_key``)."""
+        exact-content match (see ``block_key``).  ``allocator``: the
+        replica allocator to match in (default: replica 0 — the only one
+        in the common single-replica case)."""
+        if allocator is None:
+            allocator = self.allocators[0]
         bs = self.block_size
         blocks: List[int] = []
         keys: List[object] = []
         parent: Optional[int] = None
         for i in range((len(tokens) - 1) // bs):
             key = block_key(parent, tuple(tokens[i * bs:(i + 1) * bs]))
-            b = self.allocator.lookup(key)
+            b = allocator.lookup(key)
             if b is None:
                 break
-            self.allocator.ref(b)
+            allocator.ref(b)
             blocks.append(b)
             keys.append(key)
             parent = b
@@ -323,12 +455,20 @@ class StateManager:
     def admit(self, uid: int, prompt_tokens: List[int]) -> SequenceDescriptor:
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
-        if not self._free_slots:
+        if self.free_slots == 0:
             raise RuntimeError("no free sequence slots")
-        seq = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(0))
+        r = self._pick_replica(len(prompt_tokens))
+        if r is None:
+            # keep the historical contract: slot exhaustion raises here,
+            # block shortfall surfaces from allocate() below — pick any
+            # replica with a free slot and let its allocator raise
+            r = max((x for x in range(self.replicas) if self._slot_groups[x]),
+                    key=lambda x: self.allocators[x].available_blocks)
+        seq = SequenceDescriptor(uid=uid, slot=self._slot_groups[r].pop(0))
         seq.tokens = list(prompt_tokens)
         if self.enable_prefix_caching:
-            seq.blocks, seq.hashes = self._match_prefix(seq.tokens)
+            seq.blocks, seq.hashes = self._match_prefix(
+                seq.tokens, self.allocators[r])
             seq.cached_tokens = len(seq.blocks) * self.block_size
             seq.seen_tokens = seq.cached_tokens
             self.cached_prompt_tokens += seq.cached_tokens
@@ -343,7 +483,7 @@ class StateManager:
                 # only growth consults the injector: a no-growth call must
                 # stay infallible (retry loops rely on it converging)
                 self.faults.maybe_raise("alloc_exhaustion", uids=(seq.uid,))
-            seq.blocks.extend(self.allocator.allocate(n))
+            seq.blocks.extend(self._alloc_of(seq).allocate(n))
 
     def ensure_writable(self, seq: SequenceDescriptor, pos: int) -> None:
         """Copy-on-write guard: the page holding token position ``pos`` must
@@ -354,13 +494,14 @@ class StateManager:
         i = pos // self.block_size
         if i >= len(seq.blocks):
             return
+        alloc = self._alloc_of(seq)
         b = seq.blocks[i]
-        if self.allocator.refcount(b) <= 1:
+        if alloc.refcount(b) <= 1:
             return
-        [new] = self.allocator.allocate(1)
+        [new] = alloc.allocate(1)
         if self.cow_hook is not None:
             self.cow_hook(b, new)
-        self.allocator.free([b])
+        alloc.free([b])
         seq.blocks[i] = new
         del seq.hashes[i:]  # content diverges from the published chain here
         self.cow_copies += 1
@@ -390,7 +531,7 @@ class StateManager:
         tail = seq.blocks[keep:]
         del seq.blocks[keep:]
         del seq.hashes[keep:]
-        self.allocator.free(tail)
+        self._alloc_of(seq).free(tail)
         return len(tail)
 
     def extend_match(self, seq: SequenceDescriptor) -> None:
@@ -402,6 +543,7 @@ class StateManager:
         ``_match_prefix`` applies unchanged."""
         if not self.enable_prefix_caching:
             return
+        alloc = self._alloc_of(seq)
         bs = self.block_size
         cap = (len(seq.tokens) - 1) // bs
         while seq.seen_tokens == len(seq.hashes) * bs:
@@ -410,13 +552,13 @@ class StateManager:
                 break
             parent = seq.blocks[i - 1] if i else None
             key = block_key(parent, tuple(seq.tokens[i * bs:(i + 1) * bs]))
-            b = self.allocator.lookup(key)
+            b = alloc.lookup(key)
             if b is None:
                 break
             old = seq.blocks[i]
-            self.allocator.ref(b)
+            alloc.ref(b)
             seq.blocks[i] = b
-            self.allocator.free([old])
+            alloc.free([old])
             seq.hashes.append(key)
             seq.seen_tokens = (i + 1) * bs
             seq.cached_tokens = seq.seen_tokens
@@ -428,6 +570,7 @@ class StateManager:
         tokens whose KV is actually written (``seen_tokens``) count."""
         if not self.enable_prefix_caching:
             return
+        alloc = self._alloc_of(seq)
         bs = self.block_size
         full = min(seq.seen_tokens, len(seq.blocks) * bs) // bs
         while len(seq.hashes) < full:
@@ -438,8 +581,8 @@ class StateManager:
             # register only canonical chains: if the parent block lost (or
             # never won) its key, a child key naming it would dangle once
             # the parent id is reused — unreachable at best, wrong at worst
-            if parent is None or self.allocator.key_of(parent) is not None:
-                self.allocator.register(seq.blocks[i], key, parent=parent)
+            if parent is None or alloc.key_of(parent) is not None:
+                alloc.register(seq.blocks[i], key, parent=parent)
 
     def quarantine_written(self, seq: SequenceDescriptor) -> None:
         """Retract the prefix-cache keys of every block SEQ ITSELF wrote and
@@ -451,17 +594,18 @@ class StateManager:
         keys whose canonical holder is another request's block."""
         if not self.enable_prefix_caching:
             return
+        alloc = self._alloc_of(seq)
         first_own = seq.cached_tokens // self.block_size
         for i in range(first_own, min(len(seq.hashes), len(seq.blocks))):
             b = seq.blocks[i]
-            if self.allocator.key_of(b) == seq.hashes[i]:
-                self.allocator.invalidate(b)
+            if alloc.key_of(b) == seq.hashes[i]:
+                alloc.invalidate(b)
 
     def release(self, uid: int) -> None:
         seq = self.seqs.pop(uid)
         if seq.blocks:
-            self.allocator.free(seq.blocks)
-        self._free_slots.append(seq.slot)
+            self._alloc_of(seq).free(seq.blocks)
+        self._slot_groups[self.replica_of(seq)].append(seq.slot)
 
     @property
     def active(self) -> List[SequenceDescriptor]:

@@ -141,7 +141,11 @@ class ServeScheduler:
         bs = engine.block_size
         chunk = min(prefill_chunk or engine.prefill_budget, engine.prefill_budget)
         self.prefill_chunk = max(bs, (chunk // bs) * bs)
-        total = engine.mgr.allocator.total_blocks
+        # watermark headroom is per REPLICA group: on a 2-D batch x model
+        # serve mesh each replica grows its own decode batch against its own
+        # block range, so aggregate headroom in another replica's pool is
+        # unusable to it
+        total = engine.mgr.allocator.total_blocks // engine.mgr.replicas
         self._watermark_blocks = max(1, round(total * kv_watermark))
         self.starvation_ticks = starvation_ticks
         self.serve: ServeConfig = serve if isinstance(serve, ServeConfig) \
@@ -228,11 +232,14 @@ class ServeScheduler:
         # the pool with no victim left to preempt and the whole loop dies.
         max_len = min(len(tokens) + sampling.max_new_tokens, eng.max_seq_len)
         blocks = -(-max_len // eng.block_size)
-        if blocks > eng.mgr.allocator.total_blocks:
+        # a sequence lives entirely inside ONE replica's block range, so the
+        # feasibility bound is the per-replica pool, not the aggregate
+        pool = eng.mgr.allocator.total_blocks // eng.mgr.replicas
+        if blocks > pool:
             return SubmitResult(
                 uid, REJECT_POOL_IMPOSSIBLE,
-                f"prompt + max_new_tokens needs {blocks} KV blocks; the "
-                f"pool only has {eng.mgr.allocator.total_blocks}",
+                f"prompt + max_new_tokens needs {blocks} KV blocks; a "
+                f"replica's pool only has {pool}",
             )
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         if not self._running and not self.waiting:
@@ -395,9 +402,11 @@ class ServeScheduler:
         seq = mgr.admit(req.uid, req.tokens)
         fresh = total_blocks - len(seq.blocks)
         # the watermark reserves decode-growth headroom, but only while a
-        # running batch exists to grow — an idle pool admits to the brim
+        # running batch exists to grow — an idle pool admits to the brim.
+        # Checked against the CHOSEN replica's allocator: aggregate headroom
+        # in another replica's range cannot serve this sequence's growth.
         headroom = self._watermark_blocks if self._running else 0
-        if fresh + headroom > mgr.allocator.available_blocks:
+        if fresh + headroom > mgr._alloc_of(seq).available_blocks:
             mgr.release(req.uid)
             mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
             return False
@@ -438,8 +447,12 @@ class ServeScheduler:
             # blocks, and cache contents (every content change bumps
             # `registrations` or moves `available_blocks`): skip the full
             # tentative-admit probe — an O(prompt) prefix walk — when none
-            # of that moved since this request was last denied
-            state = (mgr.free_slots, mgr.allocator.available_blocks,
+            # of that moved since this request was last denied.  PER-REPLICA
+            # availability, not the aggregate: balanced cross-replica churn
+            # (one replica frees N while another consumes N) changes where a
+            # request fits without moving any aggregate number.
+            state = (mgr.free_slots,
+                     tuple(a.available_blocks for a in mgr.allocators),
                      mgr.allocator.registrations)
             self._admit_transient = False
             denied = req.denied_state == state or not self._try_admit(req)
@@ -592,9 +605,21 @@ class ServeScheduler:
 
     # -- decode + preemption ------------------------------------------------
     def _pick_victim(self, exclude: ServeRequest) -> Optional[ServeRequest]:
+        """Youngest preemptible request — restricted to the SAME replica
+        group as ``exclude`` on a partitioned pool: preempting across
+        replicas frees blocks the starved replica's allocator can never
+        hand out (it would evict innocent requests for zero relief)."""
+        mgr = self.engine.mgr
+        replica = None
+        if mgr.replicas > 1 and exclude.uid in mgr.seqs:
+            replica = mgr.replica_of(mgr.seqs[exclude.uid])
         for req in reversed(self._running):  # youngest admission first
-            if req is not exclude and req.state in (PREFILL, DECODE):
-                return req
+            if req is exclude or req.state not in (PREFILL, DECODE):
+                continue
+            if replica is not None and req.uid in mgr.seqs \
+                    and mgr.replica_of(mgr.seqs[req.uid]) != replica:
+                continue
+            return req
         return None
 
     def _preempt(self, req: ServeRequest) -> None:

@@ -130,19 +130,162 @@ def quantize_serving_weight(w: jnp.ndarray, fmt: str = "int8") -> ServingQuant:
     return ServingQuant(q=q, s=s.astype(jnp.float32))
 
 
-# Module-level switch for the fused Pallas dequant-matmul path.  TP serving
-# disables it: a pallas_call inside a GSPMD-partitioned program has no
-# sharding rule, so the partitioner would gather the full weight to every
-# shard — the jnp body partitions cleanly instead.
-_FUSED_SERVING = True
+# Serving-matmul policy.  The fused-kernel decision used to be a process-
+# global ``set_fused_serving`` switch (a TP engine pinned EVERY later engine
+# in the process to the jnp body); it is now per-call state carried by a
+# :class:`ServingContext` the engine threads through ``serving_mm``.
+class ServingContext(NamedTuple):
+    """Per-engine serving-matmul policy, threaded through ``serving_mm``.
+
+    ``mesh``/``axis``/``size`` describe the tensor-parallel model axis (the
+    ``model`` axis of ``parallel.topology``); ``size <= 1`` or ``mesh is
+    None`` means single-chip dispatch.  ``kv_cols``: whether the kv
+    projections' out-features may shard on the model axis (requires
+    ``num_kv_heads % size == 0`` — sub-head sharding is never produced; the
+    model runner passes ``kind='rep'`` for wk/wv otherwise).  ``fused``:
+    tri-state kernel gate — None = auto (fused kernel whenever the local
+    shapes qualify), False = jnp bodies everywhere (the A/B lever benches
+    use), True = same as auto (the kernel still refuses unsupported
+    shapes)."""
+
+    mesh: object = None
+    axis: str = "model"  # parallel.topology.MODEL_AXIS
+    size: int = 1
+    kv_cols: bool = True
+    fused: Optional[bool] = None
+
+    @property
+    def tp(self) -> bool:
+        return self.mesh is not None and self.size > 1
 
 
-def set_fused_serving(value: bool) -> None:
-    global _FUSED_SERVING
-    _FUSED_SERVING = bool(value)
+def _mm_local(x2d, w, bias, fused: Optional[bool]):
+    """Single-device dispatch: fused Pallas kernel on qualifying shapes
+    (unless ``fused is False``), else the jnp reference body — exactly the
+    math ``serving_mm`` has always computed."""
+    if isinstance(w, ServingQuant):
+        if fused is not False and quant_mm_kernel.supports_int8(x2d, w.q):
+            return quant_mm_kernel.quant_matmul(x2d, w.q, w.s, bias=bias)
+        y = x2d @ w.q.astype(x2d.dtype)
+        y = (y * w.s.astype(jnp.float32)).astype(x2d.dtype)
+        return y if bias is None else y + bias
+    if (
+        fused is not False
+        and w.row_shards == 1
+        and quant_mm_kernel.supports_fp6(x2d, w.packed, w.in_dim)
+    ):
+        return quant_mm_kernel.quant_matmul_fp6(
+            x2d, w.packed, w.s, w.in_dim, bias=bias
+        )
+    codes = _fp6_unpack(w.packed, w.in_dim, w.row_shards)
+    y = x2d @ _fp6_decode(codes, x2d.dtype)
+    y = (y * w.s.astype(jnp.float32)).astype(x2d.dtype)
+    return y if bias is None else y + bias
 
 
-def serving_mm(x: jnp.ndarray, w, bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+def _shard_kind(w, kind: str, ctx: ServingContext) -> str:
+    """Downgrade ``kind`` to 'rep' (replicated-compute region) when the
+    requested partition does not divide — the same divisibility conditions
+    ``auto_tp.infer_tp_rules`` applies, so the region specs always match
+    the GSPMD placement of the weight and no weight collective is ever
+    inserted at the region boundary."""
+    if isinstance(w, ServingQuant):
+        k_dim, n_dim = w.q.shape[-2], w.q.shape[-1]
+        packed_ok = True
+    else:
+        k_dim, n_dim = w.in_dim, w.packed.shape[-1]
+        # the quarter-strided FP6 pack is only row-splittable when it was
+        # packed per K-chunk for exactly this many shards (engine passes
+        # row_parallel_shards=tp at quantize time)
+        packed_ok = w.row_shards == ctx.size and w.packed.shape[-2] % ctx.size == 0
+    if kind == "col" and n_dim % ctx.size:
+        return "rep"
+    if kind == "row" and (k_dim % ctx.size or not packed_ok):
+        return "rep"
+    return kind
+
+
+def _shard_mm(x2d, w, bias, kind: str, ctx: ServingContext):
+    """One fused matmul as a manual ``shard_map`` region over the model
+    axis (the same fully-manual pattern backing the paged-attention TP
+    path — a ``pallas_call`` has no GSPMD partitioning rule, so the
+    partitioner would gather the full weight per shard; the manual region
+    keeps the compressed bytes sharded and runs the kernel per shard).
+
+    - ``col`` (qkv / up / gate / head): weight, per-output-channel scales
+      and bias all sharded on out-features; x replicated.  No collective —
+      the output stays sharded on its last dim.
+    - ``row`` (o / down): in-features sharded, fused kernel per shard on
+      its K-slice, one ``psum`` over the partial products.  The scale is a
+      per-OUT-channel multiplier, so applying it in each shard's epilogue
+      commutes with the reduction; ``bias`` is added once post-reduce by
+      the caller (``serving_mm``), never per shard.
+    - ``rep``: replicated compute (kv projections when ``num_kv_heads``
+      does not divide the axis; indivisible dims) — still a manual region
+      so the kernel never meets the GSPMD partitioner.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import shard_map_compat
+
+    ax = ctx.axis
+    fused = ctx.fused
+    is_fp6 = isinstance(w, ServingQuantFP6)
+    if is_fp6:
+        w_leaves = (w.packed, w.s)
+        rebuild = lambda p, s, in_dim, shards: ServingQuantFP6(p, s, in_dim, shards)
+        w_specs = {
+            "col": (P(None, None, ax), P(ax)),
+            "row": (P(None, ax, None), P(None)),
+            "rep": (P(None, None, None), P(None)),
+        }[kind]
+    else:
+        w_leaves = (w.q, w.s)
+        w_specs = {
+            "col": (P(None, ax), P(ax)),
+            "row": (P(ax, None), P(None)),
+            "rep": (P(None, None), P(None)),
+        }[kind]
+    x_spec = P(None, ax) if kind == "row" else P(None, None)
+    out_spec = P(None, ax) if kind == "col" else P(None, None)
+    # col/rep fuse the (sharded/replicated) bias into the local epilogue;
+    # row adds it once post-psum in the caller
+    fuse_bias = bias is not None and kind != "row"
+    n_sh = ctx.size
+
+    def body(xl, wl, sl, *rest):
+        bl = rest[0] if rest else None
+        if is_fp6:
+            local_in = w.in_dim // n_sh if kind == "row" else w.in_dim
+            # a per-chunk pack sliced to one chunk IS a standard pack
+            local_w = rebuild(wl, sl, local_in, 1)
+        else:
+            local_w = ServingQuant(wl, sl)
+        y = _mm_local(xl, local_w, bl, fused)
+        if kind == "row":
+            y = jax.lax.psum(y, ax)
+        return y
+
+    in_specs = (x_spec,) + w_specs
+    operands = (x2d,) + w_leaves
+    if fuse_bias:
+        in_specs += (P(ax) if kind == "col" else P(None),)
+        operands += (bias,)
+    y = shard_map_compat(
+        body, ctx.mesh, in_specs=in_specs, out_specs=out_spec
+    )(*operands)
+    if bias is not None and not fuse_bias:
+        y = y + bias
+    return y
+
+
+def serving_mm(
+    x: jnp.ndarray,
+    w,
+    bias: Optional[jnp.ndarray] = None,
+    kind: str = "col",
+    ctx: Optional[ServingContext] = None,
+) -> jnp.ndarray:
     """``x @ w (+ bias)`` where ``w`` may be a :class:`ServingQuant`
     (int8/fp8) or :class:`ServingQuantFP6`.
 
@@ -151,22 +294,23 @@ def serving_mm(x: jnp.ndarray, w, bias: Optional[jnp.ndarray] = None) -> jnp.nda
     the compressed bytes are the ONLY weight HBM traffic, decode happens in
     the kernel's operand-load stage, and the per-output-channel scale (and
     ``bias``) fuse into the fp32 epilogue.  Elsewhere the jnp body runs —
-    same math, XLA-fused, bit-stable with the pre-kernel path."""
-    if isinstance(w, ServingQuant):
-        if _FUSED_SERVING and quant_mm_kernel.supports_int8(x, w.q):
-            return quant_mm_kernel.quant_matmul(x, w.q, w.s, bias=bias)
-        y = x @ w.q.astype(x.dtype)
-        y = (y * w.s.astype(jnp.float32)).astype(x.dtype)
-        return y if bias is None else y + bias
-    if isinstance(w, ServingQuantFP6):
-        if _FUSED_SERVING and quant_mm_kernel.supports_fp6(x, w.packed, w.in_dim):
-            return quant_mm_kernel.quant_matmul_fp6(
-                x, w.packed, w.s, w.in_dim, bias=bias
-            )
-        codes = _fp6_unpack(w.packed, w.in_dim)
-        y = x @ _fp6_decode(codes, x.dtype)
-        y = (y * w.s.astype(jnp.float32)).astype(x.dtype)
-        return y if bias is None else y + bias
+    same math, XLA-fused, bit-stable with the pre-kernel path.
+
+    ``ctx`` (:class:`ServingContext`) carries the per-engine policy: with
+    an active TP mesh the call runs inside a manual shard_map region over
+    the model axis — ``kind`` 'col' (out-features sharded, no collective),
+    'row' (in-features sharded + one psum), or 'rep' (replicated compute)
+    — so multi-chip serving keeps in-kernel dequantization instead of the
+    old process-global ``set_fused_serving(False)`` pin.  Unquantized ``w``
+    ignores ``kind``/mesh and stays on the GSPMD path."""
+    if isinstance(w, (ServingQuant, ServingQuantFP6)):
+        fused = ctx.fused if ctx is not None else None
+        if ctx is not None and ctx.tp:
+            lead = x.shape[:-1]
+            x2d = x.reshape(-1, x.shape[-1])
+            y = _shard_mm(x2d, w, bias, _shard_kind(w, kind, ctx), ctx)
+            return y.reshape(*lead, y.shape[-1])
+        return _mm_local(x, w, bias, fused)
     y = x @ w
     return y if bias is None else y + bias
 
@@ -182,19 +326,29 @@ class ServingQuantFP6:
     elementwise bit arithmetic and contracts it against the matching
     ``x[:, i*K/4:(i+1)*K/4]`` slice — no row interleave, no strided loads.
     Decode is pure vector arithmetic (no codebook gather): sign/exp/
-    mantissa fields reassemble in the compute dtype inside the matmul."""
+    mantissa fields reassemble in the compute dtype inside the matmul.
 
-    def __init__(self, packed, s, in_dim: int):
+    ``row_shards > 1`` (tensor-parallel row-parallel layers — o/down
+    projections): the quarter-stride is applied independently within each
+    of ``row_shards`` contiguous K-chunks, laid out chunk-after-chunk along
+    the packed dim.  Sharding the packed planes on that dim then hands each
+    model shard a standalone valid pack of its contiguous K-slice — the
+    contiguous slice is exactly what the row-parallel activation sharding
+    produces, which the GLOBAL quarter-stride would not match (its quarters
+    interleave rows from all shards)."""
+
+    def __init__(self, packed, s, in_dim: int, row_shards: int = 1):
         self.packed = packed  # [..., 3, in/4, out] uint8 byte planes
         self.s = s  # [..., out] fp32
         self.in_dim = int(in_dim)
+        self.row_shards = int(row_shards)
 
     def tree_flatten(self):
-        return (self.packed, self.s), self.in_dim
+        return (self.packed, self.s), (self.in_dim, self.row_shards)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux)
+        return cls(children[0], children[1], *aux)
 
 
 jax.tree_util.register_pytree_node(
@@ -237,11 +391,19 @@ def _fp6_decode(code: jnp.ndarray, dtype) -> jnp.ndarray:
     return (jnp.where(s == 1, -mag, mag)).astype(dtype)
 
 
-def _fp6_pack(codes: jnp.ndarray) -> jnp.ndarray:
+def _fp6_pack(codes: jnp.ndarray, row_shards: int = 1) -> jnp.ndarray:
     """[..., in, out] 6-bit codes -> [..., 3, in/4, out] byte planes
     (in % 4 == 0), quarter-strided: packed row ``r`` holds the codes of
     rows ``(r, K/4+r, K/2+r, 3K/4+r)`` so the fused kernel's unpack needs
-    no row interleave (see :class:`ServingQuantFP6`)."""
+    no row interleave (see :class:`ServingQuantFP6`).  ``row_shards > 1``
+    quarter-strides each contiguous K-chunk independently and concatenates
+    the chunk packs along the packed dim (the TP row-parallel layout)."""
+    if row_shards > 1:
+        *lead, n, out = codes.shape
+        chunked = _fp6_pack(codes.reshape(*lead, row_shards, n // row_shards, out))
+        # [..., R, 3, n/(4R), out] -> [..., 3, R, n/(4R), out] -> [..., 3, n/4, out]
+        chunked = jnp.moveaxis(chunked, -4, -3)
+        return chunked.reshape(*lead, 3, n // 4, out)
     *lead, n, out = codes.shape
     c = codes.reshape(*lead, 4, n // 4, out)
     c0, c1, c2, c3 = c[..., 0, :, :], c[..., 1, :, :], c[..., 2, :, :], c[..., 3, :, :]
@@ -251,7 +413,13 @@ def _fp6_pack(codes: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([b0, b1, b2], axis=-3)
 
 
-def _fp6_unpack(packed: jnp.ndarray, in_dim: int) -> jnp.ndarray:
+def _fp6_unpack(packed: jnp.ndarray, in_dim: int, row_shards: int = 1) -> jnp.ndarray:
+    if row_shards > 1:
+        *lead, _, k4, out = packed.shape
+        chunked = packed.reshape(*lead, 3, row_shards, k4 // row_shards, out)
+        chunked = jnp.moveaxis(chunked, -3, -4)  # [..., R, 3, k4/R, out]
+        codes = _fp6_unpack(chunked, in_dim // row_shards)  # [..., R, in/R, out]
+        return codes.reshape(*lead, in_dim, out)
     b0, b1, b2 = packed[..., 0, :, :], packed[..., 1, :, :], packed[..., 2, :, :]
     c0 = b0 >> 2
     c1 = ((b0 & 0x3) << 4) | (b1 >> 4)
@@ -261,16 +429,25 @@ def _fp6_unpack(packed: jnp.ndarray, in_dim: int) -> jnp.ndarray:
     return jnp.concatenate([c0, c1, c2, c3], axis=-2)
 
 
-def quantize_serving_weight_fp6(w: jnp.ndarray) -> ServingQuantFP6:
+def quantize_serving_weight_fp6(
+    w: jnp.ndarray, row_shards: int = 1
+) -> ServingQuantFP6:
     """Per-output-channel FP6 compression of a ``[..., in, out]`` kernel
-    (in % 4 == 0)."""
-    if w.shape[-2] % 4:
-        raise ValueError(f"fp6 packing needs in-dim % 4 == 0, got {w.shape}")
+    (in % 4 == 0).  ``row_shards``: pack per contiguous K-chunk for TP
+    row-parallel sharding (requires in % (4 * row_shards) == 0)."""
+    if w.shape[-2] % (4 * row_shards):
+        raise ValueError(
+            f"fp6 packing needs in-dim % {4 * row_shards} == 0 "
+            f"(row_shards={row_shards}), got {w.shape}"
+        )
     xf = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=w.ndim - 2)  # [..., out]
     s = jnp.maximum(amax, 1e-12) / _FP6_MAX
     codes = _fp6_encode(xf / s[..., None, :])
-    return ServingQuantFP6(_fp6_pack(codes), s.astype(jnp.float32), w.shape[-2])
+    return ServingQuantFP6(
+        _fp6_pack(codes, row_shards), s.astype(jnp.float32), w.shape[-2],
+        row_shards,
+    )
 
 
 _SERVING_QUANT_PATHS = (
@@ -278,20 +455,31 @@ _SERVING_QUANT_PATHS = (
     "mlp/w_up", "mlp/w_gate", "mlp/w_down",
     "lm_head/kernel",
 )
+# row-parallel under TP serving: in-features shard on the model axis
+_SERVING_ROW_PATHS = ("attn/wo", "mlp/w_down")
 
 
-def quantize_serving_params(params, fmt: str = "int8"):
+def quantize_serving_params(params, fmt: str = "int8",
+                            row_parallel_shards: int = 1):
     """Compress the big matmul kernels of a CausalLM tree for serving
     (``fmt``: 'int8' | 'fp8' | 'fp6'); embeddings (gathers) and norms stay
     in the original dtype.  Returns the mixed tree — ``serving_mm``
-    consumes it transparently."""
+    consumes it transparently.
+
+    ``row_parallel_shards``: TP model-axis size — FP6 row-parallel kernels
+    (o/down projections) are packed per K-chunk so their byte planes shard
+    cleanly on in-features (see :class:`ServingQuantFP6`); int8/fp8 layouts
+    are chunk-agnostic and ignore it."""
     from ..runtime.zero import path_str
 
     def leaf(kp, x):
         p = path_str(kp)
         if getattr(x, "ndim", 0) >= 2 and any(p.endswith(t) for t in _SERVING_QUANT_PATHS):
             if fmt == "fp6":
-                return quantize_serving_weight_fp6(x)
+                shards = (row_parallel_shards
+                          if any(p.endswith(t) for t in _SERVING_ROW_PATHS)
+                          else 1)
+                return quantize_serving_weight_fp6(x, shards)
             return quantize_serving_weight(x, fmt)
         return x
 

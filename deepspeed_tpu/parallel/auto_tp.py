@@ -37,6 +37,15 @@ ROW_PATTERNS = (
     r"mlp\.dense_4h_to_h", r"proj_out",
 )
 EMBED_PATTERNS = (r"embed", r"wte", r"word_embeddings", r"lm_head", r"tok_embeddings")
+# attention projections: sharded at HEAD granularity only.  A column split
+# finer than one head slices head_dim across shards, which breaks every
+# head-shaped consumer (rope's rotate-half pairs, the per-head paged
+# attention) — and the sub-head reshape pattern additionally miscompiles
+# under XLA CPU SPMD (wrong values, not just bad layout; the root cause of
+# the historical tp=4 token-parity failure with num_kv_heads=2).
+Q_PATTERNS = (r"wq\b", r"q_proj", r"/query\b", r"/bq\b")
+KV_PATTERNS = (r"wk\b", r"wv\b", r"k_proj", r"v_proj", r"query_key_value",
+               r"\bqkv", r"/key\b", r"/value\b", r"/b[kv]\b")
 
 
 def _path_of(kp) -> str:
@@ -49,25 +58,50 @@ def infer_tp_rules(
     params_or_shapes: Any,
     model_axis_size: int,
     vocab_size: Optional[int] = None,
+    num_heads: Optional[int] = None,
+    num_kv_heads: Optional[int] = None,
 ) -> List[Tuple[str, P]]:
     """Emit (regex, PartitionSpec) rules for every shardable leaf.
 
     ``params_or_shapes``: a pytree of arrays or ShapeDtypeStructs.
     Returns exact-path rules (regex-escaped), consumable by
     ``zero.plan_sharding(tp_rules=...)``.
+
+    ``num_heads`` / ``num_kv_heads``: head-divisibility hints for the
+    attention projections.  With a hint given, q/k/v kernels shard their
+    out-features ONLY when the matching head count divides the model axis —
+    never below head granularity (see Q_PATTERNS/KV_PATTERNS note).  GQA
+    models with ``num_kv_heads < tp`` thus replicate wk/wv, matching the
+    replicated KV pool the paged-attention TP path uses in that regime.
+    Without hints the shape-only heuristic is unchanged.
     """
     flat = jax.tree_util.tree_flatten_with_path(params_or_shapes)[0]
     rules: List[Tuple[str, P]] = []
     col_out_sizes: Dict[int, bool] = {}
+    col_parent_dirs: Dict[str, bool] = {}  # owners of col-sharded out dims
 
     def divides(dim: int) -> bool:
         return model_axis_size > 0 and dim % model_axis_size == 0
 
-    # pass 1: 2D+ weights
+    def heads_ok(lower: str) -> bool:
+        is_kv = any(re.search(p, lower) for p in KV_PATTERNS)
+        is_q = any(re.search(p, lower) for p in Q_PATTERNS)
+        if is_kv and num_kv_heads is not None and num_kv_heads % model_axis_size:
+            return False
+        # fused query_key_value kernels carry q heads too
+        if (is_q or is_kv) and num_heads is not None and num_heads % model_axis_size:
+            return False
+        return True
+
+    # pass 1: 2D+ weights.  Quantized per-output-channel scales (the ``s``
+    # leaf of ServingQuant/ServingQuantFP6 — [out] or stacked [L, out]) are
+    # deferred to pass 2: their trailing dim is the OWNING KERNEL's out
+    # dim, so classifying them as weights here would row-shard a row-
+    # parallel kernel's scale on its leading (layer!) dim.
     for kp, leaf in flat:
         path = _path_of(kp)
         shape = tuple(leaf.shape)
-        if len(shape) < 2:
+        if len(shape) < 2 or path.endswith("/s"):
             continue
         lead = len(shape) - 2  # stacked layer/expert dims stay unsharded
         fan_in, fan_out = shape[-2], shape[-1]
@@ -80,25 +114,42 @@ def infer_tp_rules(
             if v_dims:
                 entry[v_dims[0]] = MODEL_AXIS
                 rules.append((f"^{re.escape(path)}$", P(*entry)))
+                if v_dims[0] == len(shape) - 1:  # out-dim sharded (lm head)
+                    col_parent_dirs[path.rsplit("/", 1)[0]] = True
             continue
         if any(re.search(p, lower) for p in ROW_PATTERNS):
             if divides(fan_in):
                 entry[lead] = MODEL_AXIS  # row-parallel: input dim
                 rules.append((f"^{re.escape(path)}$", P(*entry)))
             continue
-        if divides(fan_out):
+        if divides(fan_out) and heads_ok(lower):
             entry[lead + 1] = MODEL_AXIS  # column-parallel: output dim
             col_out_sizes[fan_out] = True
+            col_parent_dirs[path.rsplit("/", 1)[0]] = True
             rules.append((f"^{re.escape(path)}$", P(*entry)))
 
-    # pass 2: biases follow column-parallel outputs; everything else
-    # (norms, scalars) replicates by omission
+    # pass 2: biases and quantized per-output-channel scales follow
+    # column-parallel outputs; everything else (norms, scalars) replicates
+    # by omission
     for kp, leaf in flat:
         path = _path_of(kp)
         shape = tuple(leaf.shape)
-        if len(shape) < 1 or len(shape) >= 2:
+        if len(shape) < 1:
             continue
         lower = path.lower()
+        if path.endswith("/s"):
+            # ServingQuant/ServingQuantFP6 scale rides its kernel leaf: the
+            # [..., out] vector shards its trailing dim with a column-
+            # parallel out dim (the fused epilogue then reads only the
+            # local channels) and replicates for row-parallel kernels
+            # (their out dim is unsharded)
+            if col_parent_dirs.get(path.rsplit("/", 1)[0]) and divides(shape[-1]):
+                entry = [None] * len(shape)
+                entry[-1] = MODEL_AXIS
+                rules.append((f"^{re.escape(path)}$", P(*entry)))
+            continue
+        if len(shape) >= 2:
+            continue
         if "bias" in lower or re.search(r"/b[qkv]$", path):
             # a row-parallel layer's bias is applied AFTER the allreduce: it
             # must replicate even when its size coincides with some
@@ -106,6 +157,11 @@ def infer_tp_rules(
             # the owning layer's path, not by size alone
             if any(re.search(p, lower) for p in ROW_PATTERNS):
                 continue
+            if re.search(r"/b[kv]$", path) and num_kv_heads is not None \
+                    and num_kv_heads % model_axis_size:
+                continue  # kv projections replicated (head gating): so do
+                # their biases, even when the size happens to match a
+                # column fan_out
             if col_out_sizes.get(shape[-1]) and divides(shape[-1]):
                 rules.append((f"^{re.escape(path)}$", P(MODEL_AXIS)))
     return rules

@@ -26,6 +26,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 DATA_AXIS = "data"
+# Serving alias: on a 2-D batch×model serve mesh the continuous-batching
+# engine shards its KV pool, block tables, and slot groups over the same
+# mesh axis training uses for pure data parallelism — each ``batch``
+# coordinate is one serving replica (weights replicated over it, sharded
+# over ``model``).  ``initialize_mesh(batch=2, model=2)`` accepts the alias.
+BATCH_AXIS = DATA_AXIS
 FSDP_AXIS = "fsdp"
 SUB_AXIS = "sub"  # inner factor of fsdp: ZeRO++ hpZ secondary partition /
 # MiCS shard group (reference utils/groups.py:650, runtime/zero/mics.py:64)
@@ -205,9 +211,18 @@ class Grid:
 
 
 def initialize_mesh(spec: Optional[MeshSpec] = None, devices=None, **axes) -> Grid:
-    """One-call mesh bring-up: ``initialize_mesh(fsdp=8)``."""
+    """One-call mesh bring-up: ``initialize_mesh(fsdp=8)``.
+
+    ``batch=`` is the serving alias of ``data=`` (see BATCH_AXIS):
+    ``initialize_mesh(batch=2, model=2)`` builds the 2-D serve mesh the v2
+    engine shards its KV pool and slot groups over."""
     import jax
 
+    if "batch" in axes:
+        if "data" in axes:
+            raise ValueError("pass either batch= or data=, not both "
+                             "(batch is the serving alias of the data axis)")
+        axes["data"] = axes.pop("batch")
     n = len(devices) if devices is not None else len(jax.devices())
     if spec is None:
         spec = infer_spec(n, **axes)

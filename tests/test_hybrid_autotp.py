@@ -154,3 +154,72 @@ def test_auto_tp_indivisible_dims_replicate():
 
     tree = {"w": jnp.zeros((7, 13))}  # nothing divides 4
     assert infer_tp_rules(tree, model_axis_size=4) == []
+
+
+def test_auto_tp_head_divisibility_gates_attention_shards():
+    """Attention projections shard at HEAD granularity only: with
+    num_kv_heads=2 on a 4-way model axis, wk/wv (and their biases) must
+    replicate even though their fan_out (hkv*hd=32) divides 4 — sub-head
+    sharding slices head_dim across shards, which rope/paged-attention
+    consumers cannot survive (the root cause of the historical tp=4 token-
+    parity failure).  wq keeps sharding (4 heads / 4 shards = whole heads),
+    and without hints the shape-only heuristic is unchanged."""
+    from deepspeed_tpu.parallel.auto_tp import infer_tp_rules
+    from deepspeed_tpu.runtime.zero import match_rules
+
+    tree = {
+        "layers": {"attn": {
+            "wq": jnp.zeros((3, 64, 64)), "wk": jnp.zeros((3, 64, 32)),
+            "wv": jnp.zeros((3, 64, 32)), "wo": jnp.zeros((3, 64, 64)),
+            "bk": jnp.zeros((3, 32)),
+        }},
+    }
+    rules = infer_tp_rules(tree, model_axis_size=4, num_heads=4,
+                           num_kv_heads=2)
+    get = lambda p, s: match_rules(p, s, rules)
+    assert get("layers/attn/wq", (3, 64, 64)) == P(None, None, "model")
+    assert get("layers/attn/wk", (3, 64, 32)) == P(None, None, None)
+    assert get("layers/attn/wv", (3, 64, 32)) == P(None, None, None)
+    assert get("layers/attn/bk", (3, 32)) == P(None, None)
+    assert get("layers/attn/wo", (3, 64, 64)) == P(None, "model", None)
+    # no hints: the pure shape heuristic still shards (back-compat)
+    loose = infer_tp_rules(tree, model_axis_size=4)
+    assert match_rules("layers/attn/wk", (3, 64, 32), loose) \
+        == P(None, None, "model")
+    # num_heads gates q too (hq=2 on a 4-way axis -> replicate)
+    qgate = infer_tp_rules(tree, model_axis_size=4, num_heads=2,
+                           num_kv_heads=2)
+    assert match_rules("layers/attn/wq", (3, 64, 64), qgate) \
+        == P(None, None, None)
+
+
+def test_auto_tp_quantized_scales_shard_with_col_kernels():
+    """ServingQuant trees: the per-output-channel scale rides its kernel —
+    sharded for column-parallel layers (the fused epilogue reads only local
+    channels), replicated for row-parallel ones (out dim unsharded)."""
+    from deepspeed_tpu.ops.quantizer import quantize_serving_params
+    from deepspeed_tpu.parallel.auto_tp import infer_tp_rules
+    from deepspeed_tpu.runtime.zero import match_rules
+
+    cfg = get_preset("tiny")
+    params = CausalLM(cfg).init_params(jax.random.PRNGKey(0))
+    qparams = quantize_serving_params(params, "int8")
+    rules = infer_tp_rules(qparams, model_axis_size=2,
+                           vocab_size=cfg.vocab_size,
+                           num_heads=cfg.num_heads,
+                           num_kv_heads=cfg.num_kv_heads)
+    by = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(qparams)[0]:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+                        for k in kp)
+        by[path] = match_rules(path, tuple(leaf.shape), rules)
+    assert by["layers/attn/wq/q"] == P(None, None, "model")
+    assert by["layers/attn/wq/s"] == P(None, "model")
+    assert by["layers/mlp/w_up/s"] == P(None, "model")
+    # row-parallel kernels shard in-features; their scales replicate
+    assert by["layers/attn/wo/q"] == P(None, "model", None)
+    assert by["layers/attn/wo/s"] == P(None, None)
+    assert by["layers/mlp/w_down/s"] == P(None, None)
+    # vocab-sharded head: scale follows the sharded out (vocab) dim
+    assert by["lm_head/kernel/q"] == P(None, "model")
+    assert by["lm_head/kernel/s"] == P("model")

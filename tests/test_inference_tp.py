@@ -98,6 +98,93 @@ def test_tp_serving_rejects_bad_combos(gqa_model):
         InferenceEngineV2(params, model.cfg, grid=grid3)
 
 
+def test_2d_batch_model_mesh_token_parity(gqa_model):
+    """The 2-D batch x model serve mesh: slots and KV blocks partitioned
+    into per-replica groups over 'batch', weights sharded over 'model' —
+    greedy decode token-identical to the single-chip engine, with the pool
+    actually sharded on its block dim and every sequence's blocks affine to
+    its replica's range."""
+    model, params = gqa_model
+    kw = dict(max_seqs=4, num_blocks=64, block_size=8, prefill_buckets=(16, 32))
+    prompts = [[3, 1, 4, 1, 5], [2, 7, 1, 8, 2, 8, 1], [9, 9, 8, 2], [5, 5, 2]]
+
+    base = InferenceEngineV2(params, model.cfg, **kw)
+    want = _generate_all(base, prompts)
+
+    grid = initialize_mesh(devices=jax.devices()[:4], batch=2, model=2)
+    eng = InferenceEngineV2(params, model.cfg, grid=grid, serve_replicas=2,
+                            **kw)
+    # pool sharded over the batch axis on its BLOCK dim: half the blocks
+    # per replica — the capacity-scaling claim
+    ck, _ = eng.kv
+    assert ck[0].sharding.spec[0] == "data"  # BATCH_AXIS alias
+    assert ck[0].addressable_shards[0].data.shape[0] == 32
+
+    uids = list(range(1, len(prompts) + 1))
+    sampling = SamplingParams(max_new_tokens=6)
+    eng.put(uids, prompts, sampling)
+    # admission balanced across BOTH replica groups, and every block
+    # affine to its owner's range (the invariant the in-region block-id
+    # translation relies on)
+    reps = set()
+    for s in eng.mgr.seqs.values():
+        r = eng.mgr.replica_of(s)
+        reps.add(r)
+        per = eng.mgr._blocks_per
+        assert all(r * per <= b < (r + 1) * per for b in s.blocks), (
+            r, s.blocks)
+    assert reps == {0, 1}
+    for _ in range(5):
+        eng.step(sampling)
+    got = {u: eng.mgr.seqs[u].tokens[len(p):][:6]
+           for u, p in zip(uids, prompts)}
+    eng.flush(uids)
+    assert got == want, (got, want)
+    # released slots/blocks return to their own groups
+    eng.mgr.allocator.audit()
+    assert eng.mgr.free_slots == 4
+
+
+def test_2d_mesh_can_schedule_is_replica_aware(gqa_model):
+    """A prompt that fits the SUM of the per-replica pools but no single
+    replica must be refused by can_schedule, and a put() that slips past
+    anyway must stay all-or-nothing (nothing left admitted)."""
+    model, params = gqa_model
+    grid = initialize_mesh(devices=jax.devices()[:4], batch=2, model=2)
+    eng = InferenceEngineV2(params, model.cfg, grid=grid, serve_replicas=2,
+                            max_seqs=4, num_blocks=16, block_size=8,
+                            prefill_buckets=(16, 32, 64, 128))
+    # 8 blocks per replica; 80 tokens need 10 blocks: aggregate 16 would
+    # accept, either replica alone cannot
+    assert not eng.can_schedule([80])
+    assert eng.can_schedule([40])  # 5 blocks: fits one replica
+    # two 40-token prompts land on DIFFERENT replicas (5+5 > 8 on one)
+    assert eng.can_schedule([40, 40])
+    with pytest.raises(RuntimeError):
+        eng.put([1], [[7] * 80], SamplingParams(max_new_tokens=2))
+    # nothing leaked: no sequence admitted, all slots free
+    assert not eng.mgr.seqs and eng.mgr.free_slots == 4
+    eng.mgr.allocator.audit()
+
+
+def test_2d_mesh_rejects_bad_wiring(gqa_model):
+    model, params = gqa_model
+    kw = dict(max_seqs=4, num_blocks=64, block_size=8, prefill_buckets=(16,))
+    # replicas without a matching batch-axis grid
+    grid = make_grid(model=2)  # leftover fills data=4, not 2
+    with pytest.raises(ValueError, match="batch"):
+        InferenceEngineV2(params, model.cfg, grid=grid, serve_replicas=2, **kw)
+    grid2 = initialize_mesh(devices=jax.devices()[:4], batch=2, model=2)
+    with pytest.raises(ValueError, match="divide"):
+        InferenceEngineV2(params, model.cfg, grid=grid2, serve_replicas=2,
+                          max_seqs=3, num_blocks=64, block_size=8,
+                          prefill_buckets=(16,))
+    # features that read the pool cross-replica are gated, loudly
+    with pytest.raises(NotImplementedError, match="replica"):
+        InferenceEngineV2(params, model.cfg, grid=grid2, serve_replicas=2,
+                          enable_prefix_caching=True, **kw)
+
+
 def test_tp_serving_with_quantized_weights(gqa_model):
     """TP x int8 serving (the multi-chip capacity combo): sharded compressed
     weights must generate exactly like single-device compressed weights."""

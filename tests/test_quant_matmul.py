@@ -160,19 +160,22 @@ def test_serving_mm_routes_fused_and_falls_back():
     assert not qm.supports_int8(x, ql.q)
 
 
-def test_set_fused_serving_gate():
+def test_fused_serving_gate_is_per_call():
+    """The fused-kernel gate is per-call ServingContext state, not process
+    state: a fused=False call runs the jnp body and leaves every other call
+    (and every other engine in the process) on the kernel path."""
     rng = np.random.default_rng(5)
     x = jnp.asarray(rng.normal(size=(4, 256)), jnp.float32)
     qw = Q.quantize_serving_weight(
         jnp.asarray(rng.normal(size=(256, 128)), jnp.float32), "int8"
     )
-    try:
-        Q.set_fused_serving(False)
-        off = Q.serving_mm(x, qw)  # jnp body even though interpret is on
-    finally:
-        Q.set_fused_serving(True)
-    on = Q.serving_mm(x, qw)
+    off_ctx = Q.ServingContext(fused=False)
+    off = Q.serving_mm(x, qw, ctx=off_ctx)  # jnp body though interpret is on
+    on = Q.serving_mm(x, qw)  # default: fused (interpreter kernel)
     assert _rel(on, off) < 1e-5
+    # the process-global switch is gone — nothing for one engine to pin
+    assert not hasattr(Q, "set_fused_serving")
+    assert not hasattr(Q, "_FUSED_SERVING")
 
 
 def test_greedy_decode_token_identical_fused_vs_jnp():
