@@ -38,7 +38,7 @@ def device_peak_flops() -> float:
     return 197e12 if d.platform == "tpu" else 1e12
 
 
-def main():
+def main(quant_comm: bool = False):
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import CausalLM, get_preset
 
@@ -106,6 +106,66 @@ def main():
             },
         },
     }))
+
+    if quant_comm:
+        # `--flagship --quant-comm`: the SAME workload with ZeRO++ int8
+        # collectives (qwZ weight gathers + qgZ gradient reduces through
+        # comm/qcomm.py) vs the dense transport above — emitting the wire-
+        # byte delta (analytic, qcomm.wire_bytes at the fsdp extent) and
+        # the throughput ratio.  On a single device the int8 path is
+        # degenerate (w=1: no collective) and the section says so.
+        from deepspeed_tpu.comm import qcomm
+
+        fsdp = engine.grid.spec.fsdp * engine.grid.spec.sub
+        cfg_q = dict(config)
+        cfg_q["zero_optimization"] = {
+            "stage": 3, "param_persistence_threshold": 0,
+            "zero_quantized_weights": True, "zero_quantized_gradients": True,
+        }
+        eng_q, _, _, _ = ds.initialize(model=CausalLM(cfg), config=cfg_q)
+        loss_q = eng_q.train_batch(batch)
+        float(loss_q)
+        dt_q = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in eng_q.train_on_loader(itertools.repeat(batch, steps)):
+                pass
+            loss_qf = eng_q.get_last_loss()
+            dt_q = min(dt_q, (time.perf_counter() - t0) / steps)
+        tok_s_q = tokens_per_step / dt_q
+        # per-step wire bytes: one all-gather per param (qwZ int8 vs bf16)
+        # + one reduce-scatter per param grad (qgZ int8 vs fp32), per micro
+        n_params = model.param_count
+        n_micro = gas
+        bytes_dense = n_micro * (
+            qcomm.wire_bytes("all_gather", n_params, "none", max(fsdp, 2),
+                             none_bytes_per_el=2)
+            + qcomm.wire_bytes("reduce_scatter", n_params, "none",
+                               max(fsdp, 2))
+        )
+        bytes_q = n_micro * (
+            qcomm.wire_bytes("all_gather", n_params, "int8", max(fsdp, 2))
+            + qcomm.wire_bytes("reduce_scatter", n_params, "int8",
+                               max(fsdp, 2))
+        )
+        print(json.dumps({
+            "metric": "flagship_quant_comm_tokens_per_sec",
+            "value": round(tok_s_q, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(tok_s_q / tok_s, 3),
+            "extra": {
+                "dense_tokens_per_sec": round(tok_s, 1),
+                "loss_dense": loss_f, "loss_quant_comm": loss_qf,
+                "fsdp_extent": fsdp,
+                "collectives_active": fsdp > 1,
+                "comm_bytes_on_wire_per_step": bytes_q,
+                "comm_bytes_on_wire_per_step_dense": bytes_dense,
+                "wire_bytes_ratio": round(bytes_q / max(bytes_dense, 1), 3),
+                "note": "qwZ int8 weight gathers + qgZ int8 grad reduces "
+                        "via comm/qcomm; wire bytes analytic at the fsdp "
+                        "extent (degenerate on 1 device)",
+            },
+        }))
 
 
 def _spec_serve_section(
@@ -903,7 +963,7 @@ def quant_kernels_main():
 
 def _serve8b_tp_section(params, cfg, quant, tp, resident_gib, *, B,
                         prompt_len, steps, blocks_for, block_size, buckets,
-                        budget, samp, rng, on_tpu):
+                        budget, samp, rng, on_tpu, quant_comm=False):
     """TP serving study: fused-under-shard_map decode throughput, per-shard
     weight bandwidth, fused-vs-jnp A/B, measured collective cost, and the
     2-D batch x model mesh dryrun.  Weights arrive PRE-quantized (built
@@ -935,6 +995,38 @@ def _serve8b_tp_section(params, cfg, quant, tp, resident_gib, *, B,
     eng, tick_fused = run(None, grid)
     _, tick_jnp = run(False, grid)
     coll_ms = eng.measure_tp_collectives()
+
+    qc = None
+    if quant_comm:
+        # `--quant-comm`: the row-parallel partial sums ship int8 through
+        # qcomm (EQuARX reduce-scatter -> re-quantize -> all-gather, 4
+        # free-dim tiles for T3-style overlap) vs the exact psum above.
+        # Reported: wire bytes per tick (engine comm/* counters), measured
+        # collective chain medians for both transports, and the decode
+        # throughput ratio (the non-regression criterion).
+        eng_q, tick_q = run(None, grid, {"quant_comm": "int8",
+                                         "comm_tiles": 4})
+        coll_q = eng_q.measure_tp_collectives(fmt="int8", tiles=4)
+        def tick_bytes(e):
+            # per-DECODE-tick wire bytes, measured as the counter delta
+            # across a known burst (prefill bytes are already in the
+            # counter — a total/ticks quotient would smear them in)
+            c = e.telemetry.registry.get(f"{e._comm_ns}/bytes_on_wire")
+            b0 = c.value
+            e.step_n(4, samp)
+            return int(c.value - b0) // 4
+        qc = {
+            "decode_tokens_per_sec_int8": round(B / tick_q, 1),
+            "tokens_per_sec_ratio_vs_passthrough": round(
+                tick_fused / tick_q, 3),
+            "comm_bytes_on_wire_per_tick_int8": tick_bytes(eng_q),
+            "comm_bytes_on_wire_per_tick_passthrough": tick_bytes(eng),
+            "tp_allreduce_ms_int8": (round(coll_q, 3)
+                                     if coll_q is not None else None),
+            "tp_allreduce_ms_passthrough": (round(coll_ms, 3)
+                                            if coll_ms is not None else None),
+            "comm_tiles": 4,
+        }
     # per-shard weight traffic: each model shard streams its 1/tp of the
     # compressed bytes per tick — the roofline coordinate per chip
     per_shard_gb_s = (resident_gib / tp) * 2**30 / tick_fused / 1e9
@@ -974,6 +1066,7 @@ def _serve8b_tp_section(params, cfg, quant, tp, resident_gib, *, B,
             "fused_vs_jnp_speedup": round(tick_jnp / tick_fused, 3),
             "tp_allreduce_ms_median": (round(coll_ms, 3)
                                        if coll_ms is not None else None),
+            "quant_comm_ab": qc,
             "weights_resident_gib": round(resident_gib, 2),
             "mesh_2d_dryrun": mesh2d,
             "interpret_smoke": not on_tpu,
@@ -984,7 +1077,8 @@ def _serve8b_tp_section(params, cfg, quant, tp, resident_gib, *, B,
     }))
 
 
-def serve8b_main(quant: str = "int8", spec: bool = False, tp: int = 1):
+def serve8b_main(quant: str = "int8", spec: bool = False, tp: int = 1,
+                 quant_comm: bool = False):
     """Llama-3-8B quantized serving on ONE 16GB v5e
     (`python bench.py --serve8b [--quant int8|fp8|fp6]`): the capacity
     proof — bf16 weights alone are 15 GiB (HBM is 16), int8 + per-output-
@@ -1113,7 +1207,7 @@ def serve8b_main(quant: str = "int8", spec: bool = False, tp: int = 1):
         # (XLA_FLAGS=--xla_force_host_platform_device_count=8); on-chip
         # numbers land via BENCH_r07.
         _serve8b_tp_section(
-            params, cfg, quant, tp, resident_gib,
+            params, cfg, quant, tp, resident_gib, quant_comm=quant_comm,
             B=batches[0], prompt_len=prompt_len, steps=steps,
             blocks_for=blocks_for, block_size=block_size, buckets=buckets,
             budget=budget, samp=samp, rng=rng, on_tpu=on_tpu,
@@ -1357,6 +1451,7 @@ if __name__ == "__main__":
         tp = int(sys.argv[sys.argv.index("--tp") + 1])
     spec = "--spec" in sys.argv
     smoke = "--smoke" in sys.argv
+    quant_comm = "--quant-comm" in sys.argv
     if "--serving" in sys.argv and "--chaos" in sys.argv:
         chaos_serve_main(smoke=smoke)
     elif "--serving" in sys.argv:
@@ -1366,8 +1461,10 @@ if __name__ == "__main__":
     elif "--longctx" in sys.argv:
         longctx_main()
     elif "--serve8b" in sys.argv:
-        serve8b_main(quant=q or "int8", spec=spec, tp=tp)
+        serve8b_main(quant=q or "int8", spec=spec, tp=tp,
+                     quant_comm=quant_comm)
     elif "--quant-kernels" in sys.argv:
         quant_kernels_main()
     else:
-        main()
+        # flagship (also reachable explicitly as `--flagship`)
+        main(quant_comm=quant_comm)

@@ -25,24 +25,9 @@ import jax.numpy as jnp
 AxisNames = Union[str, Sequence[str]]
 
 
-def _axis_size_one(ax: str) -> int:
-    try:
-        return jax.lax.axis_size(ax)
-    except AttributeError:
-        # older jax has no lax.axis_size; the bound mesh is the ambient one
-        # (initialize() installs it), so its static sizes answer the query
-        from ..parallel.sharding import axis_size as ambient_axis_size
-
-        return ambient_axis_size(ax)
-
-
-def _axis_size(axis_name: AxisNames) -> int:
-    if isinstance(axis_name, str):
-        return _axis_size_one(axis_name)
-    size = 1
-    for ax in axis_name:
-        size *= _axis_size_one(ax)
-    return size
+# canonical jax.lax.axis_size-with-ambient-fallback helper (used to live
+# here; qcomm/zeropp need it too, so parallel.sharding owns the one copy)
+from ..parallel.sharding import collective_axis_size as _axis_size
 
 
 def _compress(buf: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

@@ -234,8 +234,23 @@ class ServeConfig:
     watchdog_tick_ms: Optional[float] = None
     watchdog_grace_ticks: int = 3
     fused_serving: Optional[bool] = None
+    # quantized-collective transport for TP serving's row-parallel partial
+    # sums (comm/qcomm.py): 'none' (exact lax.psum — the default, token-
+    # identical to pre-qcomm serving), 'int8' or 'fp8' (EQuARX-style
+    # quantized all-reduce, lossy within documented tolerance).
+    # ``comm_tiles`` > 1 splits each row-parallel matmul output into that
+    # many free-dim tiles reduced independently (T3-style overlap).
+    quant_comm: str = "none"
+    comm_tiles: int = 1
 
     def __post_init__(self):
+        if self.quant_comm not in ("none", "int8", "fp8"):
+            raise ConfigError(
+                f"serve.quant_comm must be one of 'none'|'int8'|'fp8', "
+                f"got {self.quant_comm!r}")
+        if self.comm_tiles < 1:
+            raise ConfigError(
+                f"serve.comm_tiles must be >= 1, got {self.comm_tiles}")
         for k in ("deadline_ms", "ttft_deadline_ms", "watchdog_tick_ms"):
             v = getattr(self, k)
             if v is not None and v <= 0:

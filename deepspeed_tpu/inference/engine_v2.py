@@ -62,6 +62,8 @@ class InferenceEngineV2:
         faults=None,
         fused_serving: Optional[bool] = None,
         serve_replicas: int = 1,
+        quant_comm: Optional[str] = None,
+        comm_tiles: Optional[int] = None,
     ):
         self.cfg = cfg
         # Families the paged v2 path cannot serve yet must refuse loudly
@@ -240,6 +242,17 @@ class InferenceEngineV2:
         # where the kernels now run inside manual shard_map regions).
         self.fused_serving = (fused_serving if fused_serving is not None
                               else self.serve.fused_serving)
+        # quantized-collective transport for the row-parallel TP psums
+        # (comm/qcomm.py): ctor arg wins, else the serve config block.
+        # 'none' keeps decode token-identical to pre-qcomm serving; the
+        # typed qcomm format check rejects anything else loudly.
+        from ..comm import qcomm as _qcomm
+
+        self.quant_comm = (quant_comm if quant_comm is not None
+                           else self.serve.quant_comm)
+        _qcomm._check_fmt(self.quant_comm)
+        self.comm_tiles = max(int(comm_tiles if comm_tiles is not None
+                                  else self.serve.comm_tiles), 1)
         from ..ops.quantizer import ServingContext
         from ..parallel.topology import MODEL_AXIS
 
@@ -249,6 +262,8 @@ class InferenceEngineV2:
             size=tp,
             kv_cols=(cfg.num_kv_heads % tp == 0),
             fused=self.fused_serving,
+            comm_fmt=self.quant_comm if tp > 1 else "none",
+            comm_tiles=self.comm_tiles,
         )
         # chaos harness (inference/faults.py): a seeded FaultInjector whose
         # scoped points fire inside this engine's dispatch sites and the
@@ -317,6 +332,16 @@ class InferenceEngineV2:
         # eagerly register this engine's request-latency group so the
         # namespace's histograms exist (empty) before any request arrives
         self.telemetry.request_hists(self._ns)
+        # comm/* telemetry: wire-byte accounting for this engine's TP
+        # collectives (analytic — payload bytes the transport puts on the
+        # wire per dispatch, from qcomm.wire_bytes; 0 without a TP mesh).
+        # The quant-comm bench diffs these across its passthrough/int8 twin
+        # runs (comm_bytes_on_wire delta is the headline wire saving).
+        self._comm_ns = self.telemetry.claim_prefix("comm")
+        self._comm_c = self.telemetry.counters(self._comm_ns, (
+            "bytes_on_wire",  # payload + scale bytes sent per device
+            "collectives",  # row-parallel reduce count (tiles included)
+        ))
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
         # SplitFuse-style token budget: multiple prompts share one prefill
         # dispatch as long as their total length fits the budget (clamped to
@@ -598,8 +623,14 @@ class InferenceEngineV2:
     def query(self, uid: int) -> Tuple[int, int]:
         """(max admissible new tokens, allocatable blocks) — admission info.
         Counts evictable cached blocks: the prefix cache retires pages to an
-        LRU instead of the free list, and allocation reclaims them."""
-        free = self.mgr.allocator.available_blocks
+        LRU instead of the free list, and allocation reclaims them.
+
+        Under ``serve_replicas > 1`` a request lives entirely inside ONE
+        replica's block range, so this reports the BEST single replica's
+        availability — the aggregate view would advertise capacity no
+        single request can actually use (the same replica-unaware
+        arithmetic admission itself no longer does)."""
+        free = max(a.available_blocks for a in self.mgr.allocators)
         return free * self.block_size, free
 
     @classmethod
@@ -818,6 +849,7 @@ class InferenceEngineV2:
         sp.dispatched()
         self._c["prefill_tokens_dispatched"].inc(n_real)
         self._c["prefill_dispatches"].inc()
+        self._account_comm(t_pad)
         poison = self._poisoned(
             [s.uid for s, _, end in entries if end == len(s.tokens)]
         )
@@ -905,16 +937,46 @@ class InferenceEngineV2:
             return jnp.asarray(x)
         return jax.device_put(x, self._rep_sharding)
 
-    def measure_tp_collectives(self, reps: int = 8) -> Optional[float]:
+    def _account_comm(self, n_tokens: int, reps: int = 1) -> None:
+        """Wire-byte accounting for ONE dispatch's row-parallel TP
+        transports (two per layer: o + down projections, [n_tokens, hidden]
+        partial sums each) into the ``comm/*`` counters — analytic from
+        ``qcomm.wire_bytes`` at this engine's transport format, so the
+        quant-comm bench can diff bytes across passthrough/int8 twin runs.
+        ``reps``: identical dispatches to account at once (a step_n burst
+        is ``n`` decode ticks).  No-op without a TP mesh."""
+        ctx = self.serving_ctx
+        if self._mesh is None or ctx.size <= 1:
+            return
+        from ..comm import qcomm
+
+        n_red = 2 * self.cfg.num_layers
+        per = qcomm.wire_bytes(
+            "all_reduce", n_tokens * self.cfg.hidden_size, ctx.comm_fmt,
+            ctx.size,
+            none_bytes_per_el=jnp.dtype(self.cfg.dtype).itemsize,
+        )
+        self._comm_c["bytes_on_wire"].inc(reps * n_red * per)
+        self._comm_c["collectives"].inc(reps * n_red * max(ctx.comm_tiles, 1))
+
+    def measure_tp_collectives(self, reps: int = 8,
+                               fmt: Optional[str] = None,
+                               tiles: Optional[int] = None) -> Optional[float]:
         """Microbenchmark THIS engine's per-decode-tick TP collective cost
-        at the served shapes — the sequential row-parallel ``psum`` chain
+        at the served shapes — the sequential row-parallel transport chain
         (two per layer: o-projection + down-projection partial products,
         [B, hidden] fp32 each) plus the vocab-sharded logits all-gather —
         and observe every rep into the ``serve/tp_allreduce_ms`` histogram
-        with a span on the engine's trace track.
+        with a span on the engine's ``comm`` trace track.
 
-        This is the cost the quantized-collectives work must attack, so it
-        is MEASURED here rather than guessed from link rooflines.  Explicit
+        ``fmt``/``tiles`` default to this engine's transport policy
+        (``quant_comm``/``comm_tiles``), so a passthrough engine measures
+        the exact ``psum`` chain and a quant-comm engine measures the
+        quantized tiled transport it actually serves with — the bench's
+        ``--quant-comm`` A/B calls both explicitly.
+
+        This is the cost the quantized-collectives work attacks, so it is
+        MEASURED here rather than guessed from link rooflines.  Explicit
         call (bench ``--serve8b --tp N`` runs it; it is not part of the
         decode hot path — a per-tick in-graph measurement would perturb the
         tick it measures).  Returns the median ms, or None without a TP
@@ -925,23 +987,32 @@ class InferenceEngineV2:
             return None
         from jax.sharding import PartitionSpec as P
 
+        from ..comm import qcomm
         from ..parallel.sharding import shard_map_compat
         from ..parallel.topology import MODEL_AXIS
 
         cfg, tp = self.cfg, self.serving_ctx.size
+        fmt = fmt if fmt is not None else self.serving_ctx.comm_fmt
+        tiles = tiles if tiles is not None else self.serving_ctx.comm_tiles
         B, d, L = self.mgr.max_seqs, cfg.hidden_size, cfg.num_layers
         v = (cfg.vocab_size // tp) * tp  # sharded-head rows, pad-free
         n_red = 2 * L
 
         def body(xs, lg):
             def step(c, x):
-                # the carry feeds each psum's operand, so XLA cannot fuse
-                # the chain into one batched collective — a decode tick
-                # issues its row-parallel reductions sequentially too
-                c = c + jax.lax.psum(x + 0.0 * c, MODEL_AXIS)
+                # the carry feeds each transport's operand, so XLA cannot
+                # fuse the chain into one batched collective — a decode
+                # tick issues its row-parallel reductions sequentially too
+                c = c + qcomm.q_psum_tiled(
+                    x + 0.0 * c, MODEL_AXIS, fmt, tiles=tiles, world=tp,
+                    out_dtype=jnp.float32,
+                )
                 return c, jnp.float32(0)
             c, _ = jax.lax.scan(step, jnp.zeros_like(xs[0]), xs)
-            full = jax.lax.all_gather(lg, MODEL_AXIS, axis=1, tiled=True)
+            full = qcomm.q_all_gather(
+                lg, MODEL_AXIS, fmt, axis=1, tiled=True,
+                out_dtype=jnp.float32,
+            )
             return c, full
 
         f = jax.jit(shard_map_compat(
@@ -955,9 +1026,10 @@ class InferenceEngineV2:
         times = []
         for _ in range(reps):
             sp = self.telemetry.recorder.start(
-                "tp_allreduce", track=self._ns,
+                "tp_allreduce", track=self._comm_ns,
                 hist=self._h["tp_allreduce_ms"],
-                reductions=n_red, gather_rows=v, tp=tp,
+                reductions=n_red, gather_rows=v, tp=tp, fmt=fmt,
+                tiles=tiles,
             )
             t0 = _time.perf_counter()
             out = f(xs, lg)
@@ -1132,6 +1204,7 @@ class InferenceEngineV2:
         sp.dispatched()
         self._c["spec_ticks"].inc()
         self._c["spec_seq_forwards"].inc(len(active_seqs))
+        self._account_comm(tokens.shape[0])
         out_np, n_out = np.asarray(out_dev), np.asarray(n_out_dev)
         sp.end()  # the fetch above is the tick's host sync
         poison = self._poisoned([s.uid for s in active_seqs])
@@ -1219,6 +1292,7 @@ class InferenceEngineV2:
         sp.dispatched()
         self._c["decode_ticks"].inc()
         self._c["decode_emitted"].inc(len(active_seqs))
+        self._account_comm(B)
         next_tokens = np.asarray(sampled)
         sp.end()  # the fetch above is the tick's host sync
         poison = self._poisoned([s.uid for s in active_seqs])
@@ -1357,6 +1431,9 @@ class InferenceEngineV2:
                     active_j, self.kv, key_dev, burst_dev, tick_dev, triple,
                 )
         sp.dispatched()
+        # a burst is n decode dispatches: account their TP wire bytes (the
+        # per-tick _decode_tick path does the same accounting per call)
+        self._account_comm(B, reps=n)
         burst = np.asarray(burst_dev)[:n]  # [n, B] — the ONE host sync
         sp = sp.end()
         if sp.duration_ms is not None:

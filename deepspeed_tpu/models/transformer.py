@@ -79,6 +79,12 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # explicit expert-parallel dispatch/combine transport (moe/layer.py
+    # routed_ffn_ep over comm/qcomm.py): None = GSPMD layout-change
+    # all-to-all (full-width, the default); 'none' = explicit shard_map
+    # all-to-all, exact; 'int8'/'fp8' = quantized wire payload.  Takes
+    # effect only when the ambient mesh has an expert axis > 1.
+    moe_qcomm: Optional[str] = None
     # training
     dtype: Any = jnp.bfloat16
     remat: str = "none"  # 'none' | 'full' | 'dots'
@@ -497,15 +503,30 @@ def decoder_layer(
 
         y = quantize_activation(y, cfg.act_quant_bits)
     if cfg.moe_num_experts > 0:
+        from ..parallel.sharding import axis_size, get_current_mesh
+        from ..parallel.topology import EXPERT_AXIS
+
+        mesh = get_current_mesh()
         if cache is not None:
             # inference (KV-cache) path: dropless routing — capacity
             # dropping is a training regularizer and would couple routing
             # to batch/padding shape (moe/layer.py moe_block_dropless)
             from ..moe.layer import moe_block_dropless as _moe
+
+            h, aux = _moe(lw["moe"], y, cfg)
+        elif (cfg.moe_qcomm is not None and mesh is not None
+                and axis_size(EXPERT_AXIS) > 1):
+            # explicit expert-parallel region: the dispatch/combine slabs
+            # travel through qcomm (quantized when asked) instead of
+            # GSPMD's full-width layout-change all-to-all
+            from ..moe.layer import routed_ffn_ep
+
+            h, aux = routed_ffn_ep(lw["moe"], y, cfg, mesh,
+                                   fmt=cfg.moe_qcomm)
         else:
             from ..moe.layer import moe_block as _moe
 
-        h, aux = _moe(lw["moe"], y, cfg)
+            h, aux = _moe(lw["moe"], y, cfg)
     else:
         h = mlp_block(lw["mlp"], tp_in(y), cfg)
     if tp_axis is not None:

@@ -90,6 +90,97 @@ def moe_block_dropless(lw: Any, x: jnp.ndarray, cfg) -> tuple[jnp.ndarray, jnp.n
     return out.reshape(b, s, d).astype(x.dtype), jnp.asarray(0.0, jnp.float32)
 
 
+def routed_ffn_ep(
+    lw: Any,
+    x: jnp.ndarray,
+    cfg,
+    mesh,
+    fmt: str = "none",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel routed FFN with EXPLICIT dispatch/combine
+    all-to-alls (comm/qcomm.py) instead of GSPMD layout-change inference.
+
+    The GSPMD path (:func:`moe_block`) leaves the all-to-all to the
+    partitioner, which always ships full-width activations.  This variant
+    runs the whole layer inside one ``shard_map`` over the ``expert`` axis
+    so the dispatch and combine slabs travel through ``q_all_to_all`` —
+    int8/fp8 payload + per-chunk fp32 scales when ``fmt`` says so, the
+    exact ``lax.all_to_all`` in ``'none'`` (the A/B lever).  Dispatch
+    weights/masks never leave the rank; only the [E, C, d] token slabs do —
+    the 2-hop pattern of the reference's ``_AllToAll`` (sharded_moe.py:96).
+
+    Layout contract: the token batch dim ``b`` shards over the DP axes AND
+    the expert axis (ep subdivides the global batch — each expert rank
+    routes its own tokens, capacity is per-rank, the reference's
+    per-ep-group capacity); experts shard on their leading ``E`` dim.  The
+    region is FULLY manual (the ring-attention pattern — partial-auto
+    shard_map miscompiles on this XLA), so it composes with the training
+    jit the same way ulysses/ring do.  Requires ``b`` divisible by
+    ``dp_total * W`` and ``E % W == 0``.
+    """
+    from ..comm import qcomm
+    from ..models.transformer import _activation
+    from ..parallel.sharding import shard_map_compat
+
+    act = _activation(cfg.activation)
+    b, s, d = x.shape
+    e = cfg.moe_num_experts
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    w = int(sizes.get(EXPERT_AXIS, 1))
+    if w <= 1:
+        return moe_block(lw, x, cfg)
+    tok_axes = BATCH + (EXPERT_AXIS,)
+    tok_div = 1
+    for a in tok_axes:
+        tok_div *= int(sizes.get(a, 1))
+    if b % tok_div or e % w:
+        raise qcomm.QCommError(
+            f"routed_ffn_ep: batch {b} must divide the dp x expert extent "
+            f"({tok_div}) and num_experts {e} the expert axis ({w})"
+        )
+    k = cfg.moe_top_k
+
+    def body(xl, router, w_gate, w_up, w_down):
+        # xl [b_local, s, d] — this rank's tokens; w_* [E/W, ...] — its experts
+        bl = xl.shape[0]
+        xf = xl.reshape(bl * s, d)
+        logits = xf.astype(jnp.float32) @ router.astype(jnp.float32)
+        gate = topk_gating(logits, k, cfg.moe_capacity_factor)
+        xe = jnp.einsum("nec,nd->ecd", gate.dispatch.astype(xl.dtype), xf)
+        # dispatch hop: each destination rank's E/W expert slab quantizes
+        # independently -> [E/W, W*C, d] local expert inboxes
+        inbox = qcomm.q_all_to_all(
+            xe, EXPERT_AXIS, fmt, split_axis=0, concat_axis=1, world=w,
+            out_dtype=xl.dtype,
+        )
+        h = act(jnp.einsum("ecd,edf->ecf", inbox, w_gate)) * jnp.einsum(
+            "ecd,edf->ecf", inbox, w_up
+        )
+        ye = jnp.einsum("ecf,efd->ecd", h, w_down)
+        # combine hop: results return to their token's rank -> [E, C, d]
+        back = qcomm.q_all_to_all(
+            ye, EXPERT_AXIS, fmt, split_axis=1, concat_axis=0, world=w,
+            out_dtype=xl.dtype,
+        )
+        out = jnp.einsum("nec,ecd->nd", gate.combine.astype(xl.dtype), back)
+        aux = jax.lax.pmean(gate.aux_loss, tok_axes)
+        return out.reshape(bl, s, d), aux
+
+    mapped = shard_map_compat(
+        body, mesh,
+        in_specs=(
+            P(tok_axes, None, None),  # tokens shard over dp x expert ranks
+            P(None, None),  # router replicated
+            P(EXPERT_AXIS, None, None),  # per-rank experts
+            P(EXPERT_AXIS, None, None),
+            P(EXPERT_AXIS, None, None),
+        ),
+        out_specs=(P(tok_axes, None, None), P()),
+        check_vma=False,
+    )
+    return mapped(x, lw["router"], lw["w_gate"], lw["w_up"], lw["w_down"])
+
+
 def moe_block(lw: Any, x: jnp.ndarray, cfg) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Routed gated-FFN used inside the transformer block.
 

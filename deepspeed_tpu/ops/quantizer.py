@@ -17,6 +17,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..comm import qcomm
 from .pallas import quant_kernel, quant_matmul as quant_mm_kernel
 
 
@@ -146,13 +147,26 @@ class ServingContext(NamedTuple):
     tri-state kernel gate — None = auto (fused kernel whenever the local
     shapes qualify), False = jnp bodies everywhere (the A/B lever benches
     use), True = same as auto (the kernel still refuses unsupported
-    shapes)."""
+    shapes).
+
+    ``comm_fmt``/``comm_tiles``: the row-parallel partial-sum TRANSPORT
+    policy (comm/qcomm.py).  ``comm_fmt`` 'none' (default) keeps the exact
+    ``lax.psum`` — bit-identical to pre-qcomm serving; 'int8'/'fp8' ship
+    the [B, hidden] partials as quantized payload + per-chunk fp32 scales
+    (EQuARX reduce-scatter → re-quantize → all-gather, fp32 carry
+    accumulation — lossy, see README for where exactness holds).
+    ``comm_tiles`` > 1 decomposes each row-parallel matmul output into
+    that many free-dim tiles, each reduced independently so tile i's
+    collective overlaps tile i+1's compute in the schedule (T3-style) —
+    volume-neutral, composes with either format."""
 
     mesh: object = None
     axis: str = "model"  # parallel.topology.MODEL_AXIS
     size: int = 1
     kv_cols: bool = True
     fused: Optional[bool] = None
+    comm_fmt: str = "none"
+    comm_tiles: int = 1
 
     @property
     def tp(self) -> bool:
@@ -253,6 +267,17 @@ def _shard_mm(x2d, w, bias, kind: str, ctx: ServingContext):
     fuse_bias = bias is not None and kind != "row"
     n_sh = ctx.size
 
+    def _slice_out(local_w, lo, hi):
+        """View of the local kernel restricted to out-channels [lo, hi) —
+        both formats keep out-features as the TRAILING dim, so the slice is
+        contiguous and the per-out-channel scales slice with it."""
+        if is_fp6:
+            return rebuild(
+                local_w.packed[..., lo:hi], local_w.s[..., lo:hi],
+                local_w.in_dim, local_w.row_shards,
+            )
+        return ServingQuant(local_w.q[..., lo:hi], local_w.s[..., lo:hi])
+
     def body(xl, wl, sl, *rest):
         bl = rest[0] if rest else None
         if is_fp6:
@@ -261,9 +286,39 @@ def _shard_mm(x2d, w, bias, kind: str, ctx: ServingContext):
             local_w = rebuild(wl, sl, local_in, 1)
         else:
             local_w = ServingQuant(wl, sl)
+        tiles = max(int(ctx.comm_tiles), 1) if kind == "row" else 1
+        n_out = (local_w.packed if is_fp6 else local_w.q).shape[-1]
+        if tiles > 1 and n_out >= tiles:
+            # T3-style fine-grained overlap: the local GEMM decomposes into
+            # free-dim (out-channel) tiles, each a SEPARATE matmul whose
+            # partial sums reduce independently — tile i's transport has no
+            # data dependence on tile i+1's matmul, so the scheduler can
+            # run them concurrently (asserted on scheduled HLO in
+            # tests/test_overlap_hlo.py).  Tiling the free dim keeps total
+            # wire volume at exactly one [B, N] payload; tiling the
+            # contraction dim instead would ship a full-width partial per
+            # tile.  Volume-neutral, composes with the quantized transport.
+            tile_n = -(-n_out // tiles)
+            outs = []
+            for i in range(tiles):
+                lo = i * tile_n
+                hi = min(lo + tile_n, n_out)
+                if lo >= hi:
+                    break
+                y_i = _mm_local(xl, _slice_out(local_w, lo, hi), None, fused)
+                outs.append(qcomm.q_all_reduce(
+                    y_i, ax, ctx.comm_fmt, world=n_sh,
+                ).astype(y_i.dtype) if ctx.comm_fmt != "none"
+                    else jax.lax.psum(y_i, ax))
+            return jnp.concatenate(outs, axis=-1)
         y = _mm_local(xl, local_w, bl, fused)
         if kind == "row":
-            y = jax.lax.psum(y, ax)
+            # partial-sum transport (comm/qcomm.py): exact lax.psum in
+            # passthrough, quantized EQuARX all-reduce in int8/fp8
+            y = qcomm.q_psum_tiled(
+                y, ax, ctx.comm_fmt, tiles=1, world=n_sh,
+                out_dtype=y.dtype,
+            )
         return y
 
     in_specs = (x_spec,) + w_specs

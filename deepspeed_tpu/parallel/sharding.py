@@ -75,6 +75,28 @@ def axis_size(name: str) -> int:
     return sizes.get(name, 1)
 
 
+def collective_axis_size(axis_name) -> int:
+    """World size of a collective axis (a name or a sequence of names) from
+    INSIDE a traced collective region: ``jax.lax.axis_size`` where this jax
+    has it, falling back to the ambient mesh's static sizes on older
+    releases (``initialize()`` installs the mesh, so the bound sizes answer
+    the query).  The one canonical copy of the fallback — comm/compressed,
+    comm/qcomm and runtime/zeropp all import it from here."""
+
+    def one(ax: str) -> int:
+        try:
+            return jax.lax.axis_size(ax)
+        except AttributeError:
+            return axis_size(ax)
+
+    if isinstance(axis_name, str):
+        return one(axis_name)
+    size = 1
+    for ax in axis_name:
+        size *= one(ax)
+    return size
+
+
 def filter_spec(shape, spec: P, mesh=None) -> P:
     """Drop spec entries whose mesh-axis product doesn't divide the dim —
     keeps tiny test shapes working while real shapes get the full spec."""

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.quantizer import dequantize, quantize_int8
+from ..comm import qcomm
 from ..parallel.topology import DATA_AXIS, FSDP_AXIS
 
 
@@ -46,30 +46,15 @@ def _fsdp_dim(spec: P) -> Optional[int]:
 def _quant_a2a_reduce(g, dim: int, w: int):
     """qgZ core: chunk → int8-quantize → all_to_all → dequantize-mean
     (the reference's ``all_to_all_quant_reduce`` with the 2-hop hierarchy
-    flattened onto ICI).  ``g`` is this rank's partial cotangent for the
-    FULL parameter; returns this rank's reduced shard plus the local
-    quantization residual (``g_sent - dequant(quant(g_sent))``) for LoCo."""
-    chunks = jnp.stack(jnp.split(g, w, axis=dim))  # [W, ...chunk]
-    qt = quantize_int8(chunks)
-    rows = qt.scales.shape[0] // w
-    residual = chunks - dequantize(qt, dtype=jnp.float32)
-    recv_q = jax.lax.all_to_all(
-        qt.data, FSDP_AXIS, split_axis=0, concat_axis=0, tiled=True
+    flattened onto ICI) — now one ``qcomm.q_reduce_scatter`` call, the
+    shared quantized-collective layer.  ``g`` is this rank's partial
+    cotangent for the FULL parameter; returns this rank's reduced shard
+    plus the local quantization residual
+    (``g_sent - dequant(quant(g_sent))``) for LoCo."""
+    return qcomm.q_reduce_scatter(
+        g, FSDP_AXIS, "int8", scatter_axis=dim, mean=True,
+        error=jnp.zeros(g.shape, jnp.float32), world=w,
     )
-    recv_s = jax.lax.all_to_all(
-        qt.scales.reshape(w, rows), FSDP_AXIS, split_axis=0, concat_axis=0,
-        tiled=True,
-    )
-    recv_q = recv_q.reshape((w,) + chunks.shape[1:])
-    total = jnp.zeros(chunks.shape[1:], jnp.float32)
-    for i in range(w):
-        total = total + dequantize(
-            qt._replace(data=recv_q[i], scales=recv_s.reshape(w, rows)[i]),
-            dtype=jnp.float32,
-        )
-    out = total / w
-    residual = jnp.concatenate([residual[i] for i in range(w)], axis=dim)
-    return out, residual
 
 
 def _gather_leaf_fn(dim: int, w: int, out_dtype, quant_weights: bool,
@@ -98,18 +83,13 @@ def _gather_leaf_fn(dim: int, w: int, out_dtype, quant_weights: bool,
     loco = loco_beta is not None
 
     def _fwd_impl(local):
-        if quant_weights:
-            qt = quantize_int8(local)
-            q_all = jax.lax.all_gather(qt.data, FSDP_AXIS)  # int8 on the wire
-            s_all = jax.lax.all_gather(qt.scales, FSDP_AXIS)
-            pieces = [
-                dequantize(qt._replace(data=q_all[i], scales=s_all[i]), dtype=out_dtype)
-                for i in range(w)
-            ]
-        else:
-            g_all = jax.lax.all_gather(local.astype(out_dtype), FSDP_AXIS)
-            pieces = [g_all[i] for i in range(w)]
-        return jnp.concatenate(pieces, axis=dim)
+        # qwZ: the shard is quantized at rest for the hop — int8 payload +
+        # per-chunk fp32 scales are the ONLY bytes on the wire (qcomm
+        # dequantizes on arrival); dense mode is the exact passthrough
+        return qcomm.q_all_gather(
+            local, FSDP_AXIS, "int8" if quant_weights else "none",
+            axis=dim, tiled=True, out_dtype=out_dtype,
+        )
 
     def _reduce_cotangent(g, err):
         g = g.astype(jnp.float32)

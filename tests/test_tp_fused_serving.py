@@ -247,13 +247,15 @@ def test_decode_hlo_no_weight_gather_one_psum_per_row_projection():
     assert not any("s8[" in l or "u8[" in l for l in gathers), (
         "quantized weight operand all-gathered:\n" +
         "\n".join(l for l in gathers if "s8[" in l or "u8[" in l))
-    # our region psums carry quantizer.py source metadata — this excludes
-    # GSPMD-inserted collectives (e.g. the vocab-sharded embedding gather's
-    # combine, which is also an f32[B, hidden] all-reduce)
+    # our region psums carry qcomm.py source metadata (the row-parallel
+    # transport moved from an inline lax.psum in quantizer.py into
+    # qcomm.q_psum_tiled) — this excludes GSPMD-inserted collectives (e.g.
+    # the vocab-sharded embedding gather's combine, which is also an
+    # f32[B, hidden] all-reduce)
     row_psums = [
         l for l in txt.splitlines()
         if re.search(rf"= f32\[{B},{cfg.hidden_size}\]\S* all-reduce\(", l)
-        and "quantizer.py" in l
+        and ("qcomm.py" in l or "quantizer.py" in l)
     ]
     assert len(row_psums) == 2 * cfg.num_layers, (
         len(row_psums), 2 * cfg.num_layers, row_psums)
