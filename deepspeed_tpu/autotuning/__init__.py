@@ -1,2 +1,13 @@
-"""Autotuning: in-process config search (reference deepspeed/autotuning/)."""
-from .autotuner import Autotuner, Experiment, autotune_model  # noqa: F401
+"""Autotuning: roofline-seeded config search over training AND serving
+knobs, scored by the bench's own metrics (see autotuner.py)."""
+from .autotuner import (  # noqa: F401
+    Autotuner,
+    Trial,
+    autotune_model,
+    autotune_serving,
+    leaderboard,
+    write_leaderboard,
+)
+from .roofline import RooflineConstants  # noqa: F401
+from .space import Knob, SearchSpace, serving_space, training_space  # noqa: F401
+from .trial import ServeTrialRunner, ServeWorkload, TrainTrialRunner  # noqa: F401

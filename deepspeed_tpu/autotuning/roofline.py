@@ -1,0 +1,297 @@
+"""Roofline cost model: the static half of the autotuner.
+
+Predicts, per candidate, the dominant resource terms of one training step
+or one serving decode tick from first principles — HBM weight-stream
+bytes, wire bytes per collective (the ``comm/qcomm.wire_bytes``
+accounting the quantized-collective layer already uses for its bench
+A/Bs), and model FLOPs — and checks memory/structural feasibility so the
+search never compiles a candidate the hardware cannot run.  The
+prediction is a *ranking and pruning* signal: knobs with no roofline
+coordinate (``kv_watermark``, ``prefill_chunk``) rank flat here and are
+differentiated by the measured trials instead.
+
+Constants come from one of two places, in preference order:
+
+1. **Calibration from bench artifacts** (:meth:`RooflineConstants.calibrate`)
+   — the repo's own ``BENCH_r0*.json`` / ``MULTICHIP_r0*.json`` runs carry
+   measured tokens/s + param counts (-> achieved compute rate) and, where
+   present, ``effective_weight_gb_s`` (-> achieved HBM stream rate) and
+   ``tp_allreduce_ms`` (-> interconnect rate).  Using achieved rates
+   instead of datasheet peaks makes predicted step times land near
+   measured ones on the same box.
+2. **Analytic defaults** (v5e datasheet numbers derated to sustained
+   fractions) when no artifact parses.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+# bytes one weight element costs on the wire/HBM per serving quant format
+_WEIGHT_BYTES = {None: 2.0, "none": 2.0, "bf16": 2.0,
+                 "int8": 1.0, "fp8": 1.0, "fp6": 0.75}
+# recompute overhead multipliers on the backward pass (coarse: full remat
+# re-runs the forward, selective re-runs the MLP intermediates)
+_REMAT_FLOPS = {"none": 1.0, "selective": 1.15, "full": 4.0 / 3.0}
+
+
+@dataclass(frozen=True)
+class RooflineConstants:
+    """Achievable (not peak) rates the cost terms divide by."""
+
+    compute_flops: float = 100e12     # sustained bf16 FLOP/s (v5e ~0.5 MFU)
+    hbm_gbps: float = 700.0           # sustained HBM stream GB/s (819 peak)
+    ici_gbps: float = 40.0            # interconnect GB/s per device
+    hbm_bytes: float = 16e9           # HBM capacity
+    host_tick_s: float = 200e-6       # per-dispatch host overhead
+    sources: Tuple[str, ...] = ()     # artifact files that informed a rate
+
+    @classmethod
+    def calibrate(cls, artifact_dir: Optional[str],
+                  patterns: Sequence[str] = ("BENCH_*.json",
+                                             "MULTICHIP_*.json"),
+                  ) -> "RooflineConstants":
+        """Fit the rate constants from bench artifacts; every constant an
+        artifact does not inform keeps its analytic default.  Unreadable /
+        alien JSON files are skipped — absence of artifacts is the normal
+        fresh-checkout case, not an error."""
+        base = cls()
+        if not artifact_dir or not os.path.isdir(artifact_dir):
+            return base
+        compute, hbm, used = [], [], []
+
+        def walk(obj):
+            """Pull every (metric, value, extra) record out of one artifact
+            (the repo's artifacts nest the bench line under 'parsed')."""
+            if isinstance(obj, dict):
+                if "metric" in obj and "value" in obj:
+                    yield obj
+                for v in obj.values():
+                    yield from walk(v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    yield from walk(v)
+
+        for pat in patterns:
+            for path in sorted(glob.glob(os.path.join(artifact_dir, pat))):
+                try:
+                    with open(path) as fh:
+                        doc = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                hit = False
+                for rec in walk(doc):
+                    extra = rec.get("extra") or {}
+                    metric = str(rec.get("metric", ""))
+                    val = rec.get("value")
+                    if not isinstance(val, (int, float)):
+                        continue
+                    if (metric.startswith("train_tokens_per_sec")
+                            and extra.get("params")):
+                        # achieved compute rate: tokens/s * ~6N FLOPs/token
+                        compute.append(val * 6.0 * float(extra["params"]))
+                        hit = True
+                    gbs = extra.get("effective_weight_gb_s")
+                    if isinstance(gbs, (int, float)) and gbs > 0:
+                        hbm.append(float(gbs))
+                        hit = True
+                    for row in (extra.get("batch_scaling") or []):
+                        g = row.get("effective_weight_gb_s")
+                        if isinstance(g, (int, float)) and g > 0:
+                            hbm.append(float(g))
+                            hit = True
+                    # NOTE: tp_allreduce_ms_median artifacts are not fitted
+                    # into ici_gbps — the measured chain's shapes are not
+                    # recorded in the artifact, so no rate is derivable;
+                    # ici keeps its analytic default (and such files are
+                    # not claimed as calibration sources)
+                if hit:
+                    used.append(os.path.basename(path))
+        out = base
+        if compute:
+            # best observed run = achievable on this box
+            out = replace(out, compute_flops=max(compute))
+        if hbm:
+            out = replace(out, hbm_gbps=max(hbm))
+        if used:
+            out = replace(out, sources=tuple(used))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# model-shape helpers
+# ---------------------------------------------------------------------------
+def _flops_per_token(model_cfg) -> float:
+    n = float(model_cfg.param_count)
+    # 6N forward+backward for training callers; serving callers use 2N
+    return 6.0 * n
+
+
+def weight_stream_bytes(model_cfg, quant) -> float:
+    """HBM bytes one full forward must stream for the weights (the decode
+    roofline term — decode matmuls are weight-bound)."""
+    per = _WEIGHT_BYTES.get(quant, 2.0)
+    scale_overhead = 0.0 if quant in (None, "none", "bf16") else 0.02
+    return float(model_cfg.param_count) * (per + scale_overhead * 4)
+
+
+def kv_pool_bytes(model_cfg, num_blocks: int, block_size: int) -> float:
+    import jax.numpy as jnp
+
+    el = jnp.dtype(model_cfg.dtype).itemsize
+    return (2.0 * model_cfg.num_layers * num_blocks * block_size
+            * model_cfg.num_kv_heads * model_cfg.hd * el)
+
+
+# ---------------------------------------------------------------------------
+# serving: feasibility + predicted tick cost
+# ---------------------------------------------------------------------------
+def serving_feasible(cand: Dict[str, Any], model_cfg, base: Dict[str, Any],
+                     n_devices: int,
+                     consts: Optional[RooflineConstants] = None,
+                     ) -> Tuple[bool, str]:
+    """Mirror of the engine's own constructor rejections + the memory
+    model, evaluated WITHOUT building anything.  ``base`` carries the
+    non-searched engine shape (max_seqs, num_blocks, block_size, ...).
+    Returns ``(ok, reason)`` — reasons become leaderboard verdicts."""
+    tp = int(cand.get("tp", 1))
+    dp = int(cand.get("serve_replicas", 1))
+    if tp < 1 or dp < 1:
+        return False, "structural: tp/serve_replicas must be >= 1"
+    if tp * dp > n_devices:
+        return False, (f"structural: tp*replicas {tp * dp} exceeds "
+                       f"{n_devices} devices")
+    if model_cfg.num_heads % tp:
+        return False, (f"structural: num_heads {model_cfg.num_heads} "
+                       f"not divisible by tp {tp}")
+    if dp > 1:
+        if (cand.get("prefix_caching", base.get("enable_prefix_caching"))
+                or cand.get("prefill_chunk") or cand.get("spec")):
+            return False, ("structural: prefix caching / chunked prefill / "
+                           "speculation are not replica-aware (engine gate)")
+        if base.get("max_seqs", 0) % dp or base.get("num_blocks", 0) % dp:
+            return False, "structural: max_seqs/num_blocks must divide replicas"
+    if cand.get("quant_comm", "none") != "none" and tp <= 1:
+        return False, "structural: quant_comm needs a TP mesh"
+    consts = consts or RooflineConstants()
+    need = (weight_stream_bytes(model_cfg, cand.get("quant")) / tp
+            + kv_pool_bytes(model_cfg, base.get("num_blocks", 0),
+                            base.get("block_size", 32)) / max(dp, 1)
+            + 0.05 * consts.hbm_bytes)  # activation/jit slack
+    if need > consts.hbm_bytes:
+        return False, (f"memory: est {need / 1e9:.2f} GB per device > "
+                       f"HBM {consts.hbm_bytes / 1e9:.1f} GB")
+    return True, "ok"
+
+
+def predict_serve_cost(cand: Dict[str, Any], model_cfg,
+                       base: Dict[str, Any],
+                       consts: Optional[RooflineConstants] = None) -> float:
+    """Predicted seconds per *emitted token* of one decode tick (lower is
+    better): weight-stream HBM time + row-parallel collective wire time
+    (qcomm accounting) + host dispatch, divided by the tick's emitted
+    tokens (batch x speculative amortization)."""
+    from ..comm import qcomm
+
+    consts = consts or RooflineConstants()
+    tp = max(int(cand.get("tp", 1)), 1)
+    dp = max(int(cand.get("serve_replicas", 1)), 1)
+    B = max(int(base.get("max_seqs", 1)), 1)
+    t = weight_stream_bytes(model_cfg, cand.get("quant")) / tp \
+        / (consts.hbm_gbps * 1e9)
+    if tp > 1:
+        n_red = 2 * model_cfg.num_layers
+        per = qcomm.wire_bytes(
+            "all_reduce", B * model_cfg.hidden_size,
+            cand.get("quant_comm", "none"), tp, none_bytes_per_el=2,
+        )
+        t += n_red * per / (consts.ici_gbps * 1e9)
+    t += consts.host_tick_s
+    emitted = float(B)
+    if cand.get("spec"):
+        # prompt-lookup acceptance on mixed workloads lands ~0.3; each
+        # verify tick emits accepted + 1 per sequence
+        emitted *= 1.0 + 0.3 * float(cand.get("spec_max_draft", 0) or 0)
+    return t / emitted
+
+
+# ---------------------------------------------------------------------------
+# training: feasibility + predicted step cost
+# ---------------------------------------------------------------------------
+def train_memory_bytes(cand: Dict[str, Any], model_cfg, seq_len: int) -> int:
+    """Per-device state + activation estimate (the model-info pruning pass
+    carried over from the pre-rewrite autotuner)."""
+    n_params = float(model_cfg.param_count)
+    mesh = cand.get("mesh") or {}
+    shard = max(int(mesh.get("fsdp", 1)), 1)
+    stage = int(cand.get("zero_stage", 0))
+    micro = int(cand.get("micro_batch", 1))
+    remat = cand.get("remat", "none")
+    state = n_params * 4 * 3 / (shard if stage >= 1 else 1)
+    compute = n_params * 2 / (shard if stage >= 3 else 1)
+    d = model_cfg.hidden_size
+    L = model_cfg.num_layers
+    f = model_cfg.intermediate_size
+    v = model_cfg.vocab_size
+    tok = micro * seq_len
+    act_per_layer = {
+        "none": tok * (2 * f + 6 * d) * 2,
+        "selective": tok * 5 * d * 2,
+        "full": tok * d * 2,
+    }.get(remat, tok * 5 * d * 2)
+    acts = L * act_per_layer + tok * v * 6  # + fp32 logits fwd/bwd
+    return int(state + compute + acts)
+
+
+def training_feasible(cand: Dict[str, Any], model_cfg, seq_len: int,
+                      n_devices: int,
+                      consts: Optional[RooflineConstants] = None,
+                      hbm_bytes: Optional[float] = None,
+                      ) -> Tuple[bool, str]:
+    mesh = cand.get("mesh") or {}
+    extent = 1
+    for v in mesh.values():
+        extent *= max(int(v), 1)
+    if extent > n_devices or (extent and n_devices % extent):
+        return False, (f"structural: mesh extent {extent} does not divide "
+                       f"{n_devices} devices")
+    cap = hbm_bytes if hbm_bytes is not None \
+        else (consts.hbm_bytes if consts else None)
+    if cap:
+        est = train_memory_bytes(cand, model_cfg, seq_len)
+        if est > cap:
+            return False, (f"memory: est {est / 1e9:.2f} GB > "
+                           f"HBM {cap / 1e9:.1f} GB")
+    return True, "ok"
+
+
+def predict_train_cost(cand: Dict[str, Any], model_cfg, seq_len: int,
+                       consts: Optional[RooflineConstants] = None) -> float:
+    """Predicted seconds per trained token (lower is better): compute with
+    the remat recompute factor + the ZeRO-3 gather/reduce wire time at the
+    candidate's fsdp extent (int8 when ZeRO++ qwZ/qgZ is on)."""
+    from ..comm import qcomm
+
+    consts = consts or RooflineConstants()
+    mesh = cand.get("mesh") or {}
+    fsdp = max(int(mesh.get("fsdp", 1)), 1)
+    micro = max(int(cand.get("micro_batch", 1)), 1)
+    tokens = micro * seq_len
+    t = tokens * _flops_per_token(model_cfg) \
+        * _REMAT_FLOPS.get(cand.get("remat", "none"), 1.0) \
+        / consts.compute_flops
+    if int(cand.get("zero_stage", 0)) >= 3 and fsdp > 1:
+        fmt = "int8" if cand.get("zero_quant") else "none"
+        n = float(model_cfg.param_count)
+        wire = (qcomm.wire_bytes("all_gather", int(n), fmt, fsdp,
+                                 none_bytes_per_el=2)
+                + qcomm.wire_bytes("reduce_scatter", int(n), fmt, fsdp))
+        t += wire / (consts.ici_gbps * 1e9)
+    t += consts.host_tick_s
+    # tiny per-micro-batch penalty so under equal rates smaller dispatch
+    # counts (bigger micro) rank first, matching the measured r3 trend
+    return t / tokens

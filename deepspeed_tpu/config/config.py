@@ -273,6 +273,128 @@ class ServeConfig:
 
 
 @dataclass
+class ServeEngineConfig:
+    """Canonical build-an-``InferenceEngineV2``-from-config seam.
+
+    One validated block capturing the serving-engine constructor surface
+    (pool shape, scheduler knobs, quant format, parallelism), so the
+    autotuner's trials, the bench's winner-verification re-run, and any
+    front end all construct engines through ONE path
+    (``inference.engine_v2.build_serve_engine``) instead of re-spelling
+    keyword soup.  ``tp``/``serve_replicas`` > 1 make the builder bring up
+    the batch x model mesh itself."""
+
+    max_seqs: int = 8
+    num_blocks: int = 96
+    block_size: int = 32
+    max_seq_len: Optional[int] = None
+    prefill_buckets: List[int] = field(
+        default_factory=lambda: [64, 128, 256])
+    prefill_budget: Optional[int] = None
+    prefill_chunk: Optional[int] = None
+    kv_watermark: float = 0.0625
+    enable_prefix_caching: bool = False
+    enable_speculation: bool = False
+    spec_max_draft: int = 4
+    quantize_weights: Optional[str] = None
+    tp: int = 1
+    serve_replicas: int = 1
+    quant_comm: str = "none"
+    comm_tiles: int = 1
+    seed: int = 0
+
+    def __post_init__(self):
+        for k in ("max_seqs", "num_blocks", "block_size", "tp",
+                  "serve_replicas", "comm_tiles"):
+            if int(getattr(self, k)) < 1:
+                raise ConfigError(f"serve_engine.{k} must be >= 1, got "
+                                  f"{getattr(self, k)}")
+        if not 0.0 <= self.kv_watermark < 1.0:
+            raise ConfigError(
+                f"serve_engine.kv_watermark must be in [0, 1), got "
+                f"{self.kv_watermark}")
+        if self.quantize_weights not in (None, "int8", "fp8", "fp6"):
+            raise ConfigError(
+                f"serve_engine.quantize_weights must be None|int8|fp8|fp6, "
+                f"got {self.quantize_weights!r}")
+        if self.quant_comm not in ("none", "int8", "fp8"):
+            raise ConfigError(
+                f"serve_engine.quant_comm must be none|int8|fp8, got "
+                f"{self.quant_comm!r}")
+        if not self.prefill_buckets:
+            raise ConfigError("serve_engine.prefill_buckets cannot be empty")
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ConfigError(
+                f"serve_engine.prefill_chunk must be >= 1 or None, got "
+                f"{self.prefill_chunk}")
+
+    def engine_kwargs(self) -> Dict[str, Any]:
+        """The ``InferenceEngineV2`` constructor kwargs this block encodes
+        (mesh construction is the builder's job — ``tp``/``serve_replicas``
+        are not raw engine kwargs)."""
+        return dict(
+            max_seqs=self.max_seqs, num_blocks=self.num_blocks,
+            block_size=self.block_size, max_seq_len=self.max_seq_len,
+            prefill_buckets=tuple(self.prefill_buckets),
+            prefill_budget=self.prefill_budget,
+            prefill_chunk=self.prefill_chunk,
+            kv_watermark=self.kv_watermark,
+            enable_prefix_caching=self.enable_prefix_caching,
+            enable_speculation=self.enable_speculation,
+            spec_max_draft=max(self.spec_max_draft, 1),
+            quantize_weights=self.quantize_weights,
+            serve_replicas=self.serve_replicas,
+            quant_comm=self.quant_comm, comm_tiles=self.comm_tiles,
+            seed=self.seed,
+        )
+
+
+@dataclass
+class AutotuneConfig:
+    """Autotuner knobs (``autotuning/`` — the roofline-seeded config
+    search).  Consumed by the offline entrypoints (``bench.py --autotune``,
+    ``autotuning.autotune_model``), never by the runtime engine — same
+    split as the reference's ds_autotuner.
+
+    ``mode`` picks the workload (``training`` | ``serving``); ``rungs``
+    are the successive-halving budget fractions (ascending, final must be
+    1.0 = the full trial workload); ``top_k`` is the rung-0 cohort size
+    taken from the roofline ranking; ``eta`` the halving divisor;
+    ``max_trials`` caps total measured runs.  ``artifacts_dir`` points the
+    roofline calibration at a directory of ``BENCH_r0*.json`` /
+    ``MULTICHIP_r0*.json`` bench artifacts (None = analytic defaults).
+    ``leaderboard_path`` is where the per-trial JSON leaderboard lands."""
+
+    enabled: bool = False
+    mode: str = "serving"
+    metric: str = "throughput"
+    max_trials: int = 16
+    top_k: int = 8
+    eta: int = 2
+    rungs: List[float] = field(default_factory=lambda: [0.25, 1.0])
+    seed: int = 0
+    artifacts_dir: Optional[str] = None
+    leaderboard_path: Optional[str] = None
+
+    def __post_init__(self):
+        if self.mode not in ("training", "serving"):
+            raise ConfigError(
+                f"autotune.mode must be training|serving, got {self.mode!r}")
+        if self.metric not in ("throughput", "latency"):
+            raise ConfigError(
+                f"autotune.metric must be throughput|latency, got "
+                f"{self.metric!r}")
+        if self.max_trials < 1 or self.top_k < 1:
+            raise ConfigError("autotune.max_trials/top_k must be >= 1")
+        if self.eta < 2:
+            raise ConfigError(f"autotune.eta must be >= 2, got {self.eta}")
+        if (not self.rungs or list(self.rungs) != sorted(self.rungs)
+                or self.rungs[0] <= 0 or abs(self.rungs[-1] - 1.0) > 1e-9):
+            raise ConfigError(
+                f"autotune.rungs must ascend and end at 1.0, got {self.rungs}")
+
+
+@dataclass
 class PrecisionConfig:
     enabled: bool = False
     loss_scale: float = 0.0  # 0 -> dynamic
@@ -663,6 +785,7 @@ class Config:
     nebula: NebulaConfig = field(default_factory=NebulaConfig)
     train_data: TrainDataConfig = field(default_factory=TrainDataConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    autotune: AutotuneConfig = field(default_factory=AutotuneConfig)
 
     # --- derived (filled by finalize) ---
     dp_world_size: int = 1

@@ -348,6 +348,17 @@ class ServeScheduler:
         self._release(req, CANCELLED)
         return True
 
+    def close(self) -> None:
+        """Drive every live request to a terminal state (CANCELLED) and
+        empty the queue — the scheduler half of ``engine.close()``: all
+        block/slot ownership goes back through the one ``_release`` path,
+        so a torn-down trial engine cannot leak pages a later engine's
+        allocator would then double-own.  Idempotent."""
+        for uid in list(self.requests):
+            self.cancel(uid)
+        self.waiting.clear()
+        self._running.clear()
+
     # -- deadlines ----------------------------------------------------------
     def _deadline_of(self, req: ServeRequest) -> Optional[float]:
         return req.deadline_ms if req.deadline_ms is not None \

@@ -317,9 +317,18 @@ class DeepSpeedTpuEngine:
             if self._offload_cpu:
                 master_params = jax.device_put(master_params, self.master_shardings)
                 opt_state = jax.device_put(opt_state, self.opt_shardings)
+                # report where the state ACTUALLY landed: backends without a
+                # registered pinned_host memory space (jax 0.4.37's CPU
+                # client exposes only unpinned_host) fall back to default
+                # placement in plan_sharding, and the log must not claim
+                # otherwise
+                kinds = sorted({
+                    str(getattr(l.sharding, "memory_kind", None))
+                    for l in jax.tree_util.tree_leaves(master_params)
+                })
                 log_dist(
-                    "ZeRO-Offload(cpu): fp32 masters + optimizer state placed "
-                    "in pinned_host memory"
+                    "ZeRO-Offload(cpu): fp32 masters + optimizer state "
+                    f"placed in {'/'.join(kinds)} memory"
                 )
 
         fp16 = config.fp16.enabled

@@ -344,6 +344,21 @@ class MetricsRegistry:
                 events.append((f"{name}/p{q}", h.percentile(q), step))
         return events
 
+    def drop_prefix(self, prefix: str) -> int:
+        """Delete every metric whose name starts with ``prefix`` (e.g.
+        ``"serve/"``).  The namespace-release half of engine teardown: a
+        later engine reclaiming the namespace re-registers FRESH metrics
+        instead of inheriting a dead engine's counts into its stats view.
+        Returns how many metrics were dropped."""
+        n = 0
+        with self._lock:
+            for table in (self._counters, self._gauges, self._histograms):
+                stale = [k for k in table if k.startswith(prefix)]
+                n += len(stale)
+                for k in stale:
+                    del table[k]
+        return n
+
     def reset_histograms(self) -> None:
         """Drop every histogram's observations (counters/gauges keep their
         values — they are baselined by differencing, not by windowing)."""

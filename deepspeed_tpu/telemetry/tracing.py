@@ -565,6 +565,20 @@ class Telemetry:
             self._prefixes.add(claimed)
             return claimed
 
+    def release_prefix(self, prefix: str, drop_metrics: bool = True) -> None:
+        """Return a claimed namespace (engine teardown): the next claimant
+        gets ``prefix`` back instead of ``prefix2``, ``prefix3``, ... —
+        back-to-back autotuner trial engines sharing one ``Telemetry``
+        would otherwise grow an unbounded namespace tail.  With
+        ``drop_metrics`` the namespace's registry metrics are deleted too,
+        so reclaimed names start from zero rather than inheriting a dead
+        engine's counts."""
+        with self._lock:
+            self._prefixes.discard(prefix)
+            self._req_hists.pop(prefix, None)
+        if drop_metrics:
+            self.registry.drop_prefix(prefix + "/")
+
     # -- request traces -----------------------------------------------------
     def request_hists(self, ns: str) -> Dict[str, Any]:
         """The request-latency histogram group for one engine namespace
