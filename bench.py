@@ -114,8 +114,6 @@ def main(quant_comm: bool = False):
         # byte delta (analytic, qcomm.wire_bytes at the fsdp extent) and
         # the throughput ratio.  On a single device the int8 path is
         # degenerate (w=1: no collective) and the section says so.
-        from deepspeed_tpu.comm import qcomm
-
         fsdp = engine.grid.spec.fsdp * engine.grid.spec.sub
         cfg_q = dict(config)
         cfg_q["zero_optimization"] = {
@@ -135,19 +133,15 @@ def main(quant_comm: bool = False):
         tok_s_q = tokens_per_step / dt_q
         # per-step wire bytes: one all-gather per param (qwZ int8 vs bf16)
         # + one reduce-scatter per param grad (qgZ int8 vs fp32), per micro
+        # — the shared comm/budget enumeration (roofline uses the same)
+        from deepspeed_tpu.comm.budget import plan_bytes, zero3_step_plan
+
         n_params = model.param_count
         n_micro = gas
-        bytes_dense = n_micro * (
-            qcomm.wire_bytes("all_gather", n_params, "none", max(fsdp, 2),
-                             none_bytes_per_el=2)
-            + qcomm.wire_bytes("reduce_scatter", n_params, "none",
-                               max(fsdp, 2))
-        )
-        bytes_q = n_micro * (
-            qcomm.wire_bytes("all_gather", n_params, "int8", max(fsdp, 2))
-            + qcomm.wire_bytes("reduce_scatter", n_params, "int8",
-                               max(fsdp, 2))
-        )
+        bytes_dense = plan_bytes(zero3_step_plan(
+            n_params, max(fsdp, 2), "none", micro_batches=n_micro))
+        bytes_q = plan_bytes(zero3_step_plan(
+            n_params, max(fsdp, 2), "int8", micro_batches=n_micro))
         print(json.dumps({
             "metric": "flagship_quant_comm_tokens_per_sec",
             "value": round(tok_s_q, 1),
@@ -1565,6 +1559,132 @@ def autotune_training_main(smoke: bool = False, out: str = None):
     return board
 
 
+def audit_main(smoke: bool = False, out: str = None):
+    """`python bench.py --audit [--smoke] [--out FILE]`: the Graft Auditor
+    report (deepspeed_tpu/analysis/) — prove the stack's invariants from
+    the compiled programs instead of regexing for them.  Sections:
+
+    - **astlint** — the three source-lint passes over ``deepspeed_tpu/``
+      (host syncs in tick/step hot paths, new process-global mutable
+      state, raw lax collectives outside comm/);
+    - **serve** — compiled-program audit of every serving hot jit (decode,
+      packed prefill, ctx-pack prefill, speculative verify) on a tp=2
+      engine in BOTH transports (passthrough and int8 + tiles): donation
+      (KV/state input-output aliasing), collective wire-byte budget vs the
+      shared ``comm/budget`` plan, exact payload-dtype audit, and the TP
+      parameter-sharding lint;
+    - **train** — the fused ZeRO-3 train-step jit under ZeRO++ quantized
+      collectives (state donation + int8 wire dtypes).
+
+    ``--smoke`` forces the virtual 8-device CPU mesh (the test harness's
+    world).  Prints one JSON metric line (total violations) and writes the
+    full per-jit report to ``--out`` (default ``audit_report.json``).
+    CI-gateable: exits non-zero on any violation."""
+    import os
+
+    # the virtual-device flag must land before the backend initializes; it
+    # only affects the CPU client, so it is safe to set unconditionally
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis import (
+        audit_serve_engine,
+        audit_train_step,
+        lint_package,
+    )
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import CausalLM, get_preset
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+
+    report = {}
+    lint = lint_package()
+    report["astlint"] = {"passed": not lint,
+                         "violations": [str(v) for v in lint]}
+
+    n_dev = len(jax.devices())
+    tp = 2 if n_dev >= 2 else 1
+    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32).replace(
+        hidden_size=512, intermediate_size=512, num_heads=4, num_kv_heads=2,
+    )
+    params = CausalLM(cfg).init_params(jax.random.PRNGKey(0))
+    kw = dict(max_seqs=2, num_blocks=64, block_size=8, prefill_buckets=(16,),
+              enable_speculation=True, spec_max_draft=2)
+    report["serve"] = {}
+    for label, qc, tiles in (("passthrough", "none", 1), ("int8", "int8", 2)):
+        grid = (initialize_mesh(devices=jax.devices()[:tp], model=tp)
+                if tp > 1 else None)
+        eng = InferenceEngineV2(
+            params, cfg, grid=grid, quantize_weights="int8", quant_comm=qc,
+            comm_tiles=tiles, **kw,
+        )
+        report["serve"][label] = audit_serve_engine(eng)
+
+    # fused train step: tiny fsdp-sharded MLP, ZeRO-3 + ZeRO++ int8 wires
+    fsdp = min(8, n_dev)
+
+    def loss_fn(p, batch, rng):
+        h = batch["x"]
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k])
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    tparams = {
+        f"w{i}": jax.random.normal(jax.random.PRNGKey(i), (64, 64)) * 0.1
+        for i in range(2)
+    }
+    engine, _, _, _ = ds.initialize(
+        loss_fn=loss_fn, params=tparams,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "stage": 3, "param_persistence_threshold": 0,
+                "zero_quantized_weights": True,
+                "zero_quantized_gradients": True,
+            },
+            "steps_per_print": 10**6,
+        },
+        mesh=ds.initialize_mesh(fsdp=fsdp) if fsdp > 1 else None,
+    )
+    rs = np.random.RandomState(0)
+    batch = {"x": rs.randn(1, 2 * fsdp, 64).astype(np.float32),
+             "y": rs.randn(1, 2 * fsdp, 64).astype(np.float32)}
+    report["train"] = audit_train_step(
+        engine, batch, quantized_comm=fsdp > 1)
+
+    def _count(node):
+        if isinstance(node, dict):
+            n = len(node.get("violations", [])) if "check" in node else 0
+            return n + sum(_count(v) for v in node.values())
+        if isinstance(node, list):
+            return sum(_count(v) for v in node)
+        return 0
+
+    n_viol = len(lint) + _count(report["serve"]) + _count(report["train"])
+    out = out or "audit_report.json"
+    with open(out, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+    print(json.dumps({
+        "metric": "audit_violations_total",
+        "value": n_viol,
+        "unit": "count",
+        "vs_baseline": None,
+        "extra": {
+            "astlint_passed": report["astlint"]["passed"],
+            "serve_passed": {k: v["passed"]
+                             for k, v in report["serve"].items()},
+            "serve_jits_audited": sorted(
+                report["serve"]["passthrough"]["jits"]),
+            "train_passed": report["train"]["passed"],
+            "tp": tp, "devices": n_dev, "report": out,
+        },
+    }))
+    if n_viol:
+        raise SystemExit(1)
+
+
 def longctx_main():
     """Long-context single-chip proof (`python bench.py --longctx`): one
     training step at seq >= 128k with flash attention + selective remat +
@@ -1656,7 +1776,15 @@ if __name__ == "__main__":
     spec = "--spec" in sys.argv
     smoke = "--smoke" in sys.argv
     quant_comm = "--quant-comm" in sys.argv
-    if "--autotune" in sys.argv:
+    if "--audit" in sys.argv:
+        out = None
+        if "--out" in sys.argv:
+            i = sys.argv.index("--out") + 1
+            if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+                raise SystemExit("--out needs a file path argument")
+            out = sys.argv[i]
+        audit_main(smoke=smoke, out=out)
+    elif "--autotune" in sys.argv:
         out = None
         if "--out" in sys.argv:
             i = sys.argv.index("--out") + 1

@@ -1,0 +1,65 @@
+"""Graft Auditor — static analysis over the stack's compiled programs.
+
+Two halves (README "Static analysis & program audit"):
+
+- **Compiled-program auditor** (:mod:`hlo`, :mod:`checks`, :mod:`audit`):
+  a structured parser over scheduled HLO / StableHLO text producing typed
+  :class:`~deepspeed_tpu.analysis.hlo.Collective` / ``Donation`` /
+  ``AsyncPair`` records per jit, plus checker passes that prove the
+  invariants the stack claims — collective wire-byte budgets against the
+  ``comm/budget`` analytic plan, input-output aliasing (donation) of the
+  hot jits' KV/param buffers, TP sharding rules (head granularity, scale
+  placement), async start/done overlap, and a compilation-cache recompile
+  sentinel.  The former scheduled-HLO regex tests ride on these records.
+- **Source-level lint** (:mod:`astlint`): AST passes over ``deepspeed_tpu``
+  forbidding host syncs in the tick/step hot paths, new process-global
+  mutable state, and raw ``lax`` collectives outside ``comm/``.
+
+Entry points: ``bench.py --audit`` (JSON report) and the pytest gate in
+``tests/test_analysis.py`` (tier-1 fast lane).
+"""
+from .astlint import LintViolation, lint_package, lint_source
+from .audit import audit_serve_engine, audit_train_step, serve_jit_specs
+from .checks import (
+    CheckResult,
+    RecompileSentinel,
+    Violation,
+    check_collective_budget,
+    check_donation,
+    check_overlap,
+    check_payload_dtypes,
+    check_tp_param_sharding,
+)
+from .hlo import (
+    AsyncPair,
+    Collective,
+    Donation,
+    ProgramFacts,
+    parse_scheduled_hlo,
+    program_facts,
+    stablehlo_collectives,
+)
+
+__all__ = [
+    "AsyncPair",
+    "audit_serve_engine",
+    "audit_train_step",
+    "serve_jit_specs",
+    "CheckResult",
+    "Collective",
+    "Donation",
+    "LintViolation",
+    "ProgramFacts",
+    "RecompileSentinel",
+    "Violation",
+    "check_collective_budget",
+    "check_donation",
+    "check_overlap",
+    "check_payload_dtypes",
+    "check_tp_param_sharding",
+    "lint_package",
+    "lint_source",
+    "parse_scheduled_hlo",
+    "program_facts",
+    "stablehlo_collectives",
+]

@@ -1,0 +1,236 @@
+"""Source-level lint: AST passes over ``deepspeed_tpu``.
+
+Three rules, each guarding an invariant the runtime cannot check for
+itself:
+
+- **host-sync-in-hot-path** — ``jax.block_until_ready`` / ``device_get`` /
+  ``.item()`` / ``float(<expr>)`` inside the serving tick/step hot paths
+  force a device round trip per call; one stray sync stretched decode
+  ticks from ~14 ms to 20-70 ms historically.  Scoped to the functions in
+  :data:`HOT_PATHS` (``"*"`` = every function in the file; traced model
+  code can never legally host-sync).
+- **process-global-mutable-state** — a ``global`` rebind is how the
+  ``set_fused_serving`` class of bug enters (one engine's flip silently
+  reconfigures every later engine in the process).  Existing globals are
+  grandfathered in :data:`GLOBAL_BASELINE`; the set may only shrink.
+- **raw-lax-collective** — ``lax.psum`` & friends outside ``comm/`` bypass
+  the qcomm transport layer, so the ``fmt='none'`` A/B lever stops being
+  universal.  Pre-qcomm training-side modules are grandfathered in
+  :data:`LAX_COLLECTIVE_BASELINE`; serving-side code must route through
+  ``comm.qcomm``.
+
+A trailing ``# lint: allow(<rule>)`` comment on the offending line
+suppresses that line (for the rare measured-and-documented exception).
+The tier-1 gate (``tests/test_analysis.py``) runs :func:`lint_package`
+over the repo and fails on any violation.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# functions whose bodies may never host-sync (file -> names, "*" = all).
+# Keys are repo-relative paths under deepspeed_tpu/.
+HOT_PATHS: Dict[str, Set[str]] = {
+    # engine tick/step loop: one deliberate np.asarray fetch per tick is the
+    # design; any OTHER sync primitive here is a regression
+    "inference/engine_v2.py": {
+        "_run_packed_prefill", "prefill_entries", "_decode_tick",
+        "_spec_tick", "step", "step_n", "_tables_device",
+        "_sampling_device", "_account_comm", "_set_block_table",
+    },
+    # the serve loop's per-tick driver
+    "inference/scheduler.py": {"tick"},
+    # traced model code: a host sync here is a trace-time bug by definition
+    "inference/model_runner.py": {"*"},
+    "inference/sampling.py": {"*"},
+    "inference/paged.py": {"*"},
+}
+
+# grandfathered `global` rebinds: (file, name).  Shrink-only.
+GLOBAL_BASELINE: Set[Tuple[str, str]] = {
+    ("accelerator/tpu_accelerator.py", "_accelerator"),
+    ("comm/comm.py", "_comms_logger"),
+    ("comm/comm.py", "_initialized"),
+    ("inference/faults.py", "_GLOBAL"),
+    ("ops/pallas/flash_kernel.py", "_INTERPRET"),
+    ("ops/pallas/flash_kernel.py", "_BLOCK_Q"),
+    ("ops/pallas/flash_kernel.py", "_BLOCK_K"),
+    ("ops/pallas/flash_kernel.py", "_BLOCK_Q_BWD"),
+    ("ops/pallas/flash_kernel.py", "_BLOCK_K_BWD"),
+    ("ops/pallas/fused_adam.py", "_INTERPRET"),
+    ("ops/pallas/paged_attention.py", "_INTERPRET"),
+    ("ops/pallas/quant_kernel.py", "_INTERPRET"),
+    ("ops/pallas/quant_matmul.py", "_INTERPRET"),
+    ("parallel/sharding.py", "_CURRENT_MESH"),
+    ("runtime/engine.py", "_EXIT_HOOK_REGISTERED"),
+}
+
+# raw lax collectives allowed per file.  comm/* is the implementation
+# layer; the training-side modules predate qcomm and keep their exact lax
+# calls (ZeRO/pipeline/sequence graphs are passthrough-only by design).
+# Serving code (inference/, ops/quantizer) must route through comm.qcomm.
+LAX_COLLECTIVE_BASELINE: Set[str] = {
+    "comm/comm.py",
+    "comm/compressed.py",
+    "comm/qcomm.py",
+    "models/transformer.py",
+    "moe/layer.py",
+    "ops/sparse_grads.py",
+    "runtime/onebit.py",
+    "runtime/pipeline/pipelined.py",
+    "runtime/zeropp.py",
+    "sequence/cross_entropy.py",
+    "sequence/layer.py",
+    "sequence/ring.py",
+}
+
+_LAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "ppermute", "pshuffle", "all_gather_invariant",
+}
+_HOST_SYNC_ATTRS = {"block_until_ready", "item"}
+_HOST_SYNC_FUNCS = {"device_get"}
+
+
+@dataclass(frozen=True)
+class LintViolation:
+    rule: str  # 'host-sync' | 'global-state' | 'lax-collective'
+    path: str  # repo-relative file
+    line: int
+    message: str
+
+    def __str__(self) -> str:  # pytest-friendly
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _allowed(source_lines: Sequence[str], lineno: int, rule: str) -> bool:
+    if 0 < lineno <= len(source_lines):
+        return f"lint: allow({rule})" in source_lines[lineno - 1]
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, source_lines: Sequence[str]):
+        self.relpath = relpath
+        self.lines = source_lines
+        self.hot_names = HOT_PATHS.get(relpath)
+        self.func_stack: List[str] = []
+        self.out: List[LintViolation] = []
+
+    # -- helpers ----------------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        if not _allowed(self.lines, node.lineno, rule):
+            self.out.append(LintViolation(rule, self.relpath, node.lineno, msg))
+
+    def _in_hot_path(self) -> bool:
+        if self.hot_names is None or not self.func_stack:
+            return False
+        return "*" in self.hot_names or bool(
+            set(self.func_stack) & self.hot_names
+        )
+
+    # -- rule: global mutable state ---------------------------------------
+    def visit_Global(self, node: ast.Global) -> None:
+        for name in node.names:
+            if (self.relpath, name) not in GLOBAL_BASELINE:
+                self._emit(
+                    "global-state", node,
+                    f"new process-global mutable state 'global {name}' — "
+                    "one call site reconfigures every engine in the process "
+                    "(the set_fused_serving bug class); carry the state on "
+                    "the engine/context object instead",
+                )
+        self.generic_visit(node)
+
+    # -- rule: raw lax collectives ----------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        is_lax = (
+            (isinstance(node.value, ast.Name) and node.value.id == "lax")
+            or (isinstance(node.value, ast.Attribute)
+                and node.value.attr == "lax")
+        )
+        if node.attr in _LAX_COLLECTIVES and is_lax:
+            if self.relpath not in LAX_COLLECTIVE_BASELINE:
+                self._emit(
+                    "lax-collective", node,
+                    f"raw lax.{node.attr} outside comm/ — route through "
+                    "comm.qcomm so the fmt='none' A/B lever stays universal",
+                )
+        self.generic_visit(node)
+
+    # -- rule: host sync in hot paths --------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_hot_path():
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in _HOST_SYNC_ATTRS or fn.attr in _HOST_SYNC_FUNCS:
+                    self._emit(
+                        "host-sync", node,
+                        f".{fn.attr}() in hot path "
+                        f"{'/'.join(self.func_stack)} — forces a device "
+                        "round trip per call; fetch once per tick via the "
+                        "designed np.asarray sync point",
+                    )
+            elif isinstance(fn, ast.Name):
+                if fn.id in _HOST_SYNC_FUNCS:
+                    self._emit(
+                        "host-sync", node,
+                        f"{fn.id}() in hot path — device round trip",
+                    )
+                elif fn.id == "float" and node.args and isinstance(
+                        node.args[0], (ast.Call, ast.Subscript, ast.Attribute)):
+                    # float(expr) on a computed value is the classic hidden
+                    # blocking fetch; float(name)/float(literal) stay legal
+                    self._emit(
+                        "host-sync", node,
+                        "float(<computed expr>) in hot path — if the operand "
+                        "is a device array this blocks on it; hoist the "
+                        "fetch to the tick's single sync point",
+                    )
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def lint_source(source: str, relpath: str) -> List[LintViolation]:
+    """Lint one module's source as repo-relative ``relpath`` (the key space
+    of the HOT_PATHS / baseline tables) — the seeded-regression seam."""
+    tree = ast.parse(source)
+    v = _Visitor(relpath, source.splitlines())
+    v.visit(tree)
+    return v.out
+
+
+def lint_package(root: Optional[str] = None,
+                 exclude: Sequence[str] = ("analysis/*",),
+                 ) -> List[LintViolation]:
+    """Lint every ``.py`` under ``deepspeed_tpu/`` (or ``root``).  The
+    analysis package itself is excluded by default (its lint tables quote
+    the forbidden names)."""
+    root = root or PKG_ROOT
+    out: List[LintViolation] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            out.extend(lint_source(src, rel))
+    return out

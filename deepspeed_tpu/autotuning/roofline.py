@@ -192,10 +192,13 @@ def predict_serve_cost(cand: Dict[str, Any], model_cfg,
                        base: Dict[str, Any],
                        consts: Optional[RooflineConstants] = None) -> float:
     """Predicted seconds per *emitted token* of one decode tick (lower is
-    better): weight-stream HBM time + row-parallel collective wire time
-    (qcomm accounting) + host dispatch, divided by the tick's emitted
-    tokens (batch x speculative amortization)."""
-    from ..comm import qcomm
+    better): weight-stream HBM time + collective wire time (the shared
+    ``comm/budget`` tick plan — row-parallel transports at the candidate's
+    format plus GSPMD's format-independent overhead, the same enumeration
+    the engine accounts and the Graft Auditor verifies against compiled
+    HLO) + host dispatch, divided by the tick's emitted tokens (batch x
+    speculative amortization)."""
+    from ..comm.budget import plan_bytes, serving_tick_plan
 
     consts = consts or RooflineConstants()
     tp = max(int(cand.get("tp", 1)), 1)
@@ -204,12 +207,11 @@ def predict_serve_cost(cand: Dict[str, Any], model_cfg,
     t = weight_stream_bytes(model_cfg, cand.get("quant")) / tp \
         / (consts.hbm_gbps * 1e9)
     if tp > 1:
-        n_red = 2 * model_cfg.num_layers
-        per = qcomm.wire_bytes(
-            "all_reduce", B * model_cfg.hidden_size,
-            cand.get("quant_comm", "none"), tp, none_bytes_per_el=2,
+        plan = serving_tick_plan(
+            model_cfg, B, tp, cand.get("quant_comm", "none"),
+            sample_rows=B, compute_itemsize=2,
         )
-        t += n_red * per / (consts.ici_gbps * 1e9)
+        t += plan_bytes(plan) / (consts.ici_gbps * 1e9)
     t += consts.host_tick_s
     emitted = float(B)
     if cand.get("spec"):
@@ -273,8 +275,9 @@ def predict_train_cost(cand: Dict[str, Any], model_cfg, seq_len: int,
                        consts: Optional[RooflineConstants] = None) -> float:
     """Predicted seconds per trained token (lower is better): compute with
     the remat recompute factor + the ZeRO-3 gather/reduce wire time at the
-    candidate's fsdp extent (int8 when ZeRO++ qwZ/qgZ is on)."""
-    from ..comm import qcomm
+    candidate's fsdp extent (the shared ``comm/budget.zero3_step_plan``;
+    int8 when ZeRO++ qwZ/qgZ is on)."""
+    from ..comm.budget import plan_bytes, zero3_step_plan
 
     consts = consts or RooflineConstants()
     mesh = cand.get("mesh") or {}
@@ -286,10 +289,8 @@ def predict_train_cost(cand: Dict[str, Any], model_cfg, seq_len: int,
         / consts.compute_flops
     if int(cand.get("zero_stage", 0)) >= 3 and fsdp > 1:
         fmt = "int8" if cand.get("zero_quant") else "none"
-        n = float(model_cfg.param_count)
-        wire = (qcomm.wire_bytes("all_gather", int(n), fmt, fsdp,
-                                 none_bytes_per_el=2)
-                + qcomm.wire_bytes("reduce_scatter", int(n), fmt, fsdp))
+        wire = plan_bytes(zero3_step_plan(
+            int(model_cfg.param_count), fsdp, fmt))
         t += wire / (consts.ici_gbps * 1e9)
     t += consts.host_tick_s
     # tiny per-micro-batch penalty so under equal rates smaller dispatch

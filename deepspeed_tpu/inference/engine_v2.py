@@ -339,7 +339,12 @@ class InferenceEngineV2:
         # runs (comm_bytes_on_wire delta is the headline wire saving).
         self._comm_ns = self.telemetry.claim_prefix("comm")
         self._comm_c = self.telemetry.counters(self._comm_ns, (
-            "bytes_on_wire",  # payload + scale bytes sent per device
+            "bytes_on_wire",  # transport payload + scale bytes per device
+            # format-INDEPENDENT wire GSPMD inserts around the sharded
+            # embedding/head and residual stream (comm/budget.py overhead
+            # group) — kept separate so the quant-comm A/B delta on
+            # bytes_on_wire stays a pure transport comparison
+            "bytes_on_wire_overhead",
             "collectives",  # row-parallel reduce count (tiles included)
         ))
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_seq_len] or [self.max_seq_len]
@@ -826,6 +831,7 @@ class InferenceEngineV2:
         self._rng, sub = jax.random.split(self._rng)
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         n_real = sum(end - start for _, start, end in entries)
+        n_slots = self.mgr.max_seqs  # logits rows a pack dispatch scores
         sp = self.telemetry.recorder.start(
             "prefill_pack", track=self._ns, hist=self._h["prefill_pack_ms"],
             tokens=n_real, pad=t_pad, entries=len(entries), ctx=use_ctx,
@@ -849,7 +855,7 @@ class InferenceEngineV2:
         sp.dispatched()
         self._c["prefill_tokens_dispatched"].inc(n_real)
         self._c["prefill_dispatches"].inc()
-        self._account_comm(t_pad)
+        self._account_comm(t_pad, sample_rows=n_slots)
         poison = self._poisoned(
             [s.uid for s, _, end in entries if end == len(s.tokens)]
         )
@@ -937,27 +943,37 @@ class InferenceEngineV2:
             return jnp.asarray(x)
         return jax.device_put(x, self._rep_sharding)
 
-    def _account_comm(self, n_tokens: int, reps: int = 1) -> None:
-        """Wire-byte accounting for ONE dispatch's row-parallel TP
-        transports (two per layer: o + down projections, [n_tokens, hidden]
-        partial sums each) into the ``comm/*`` counters — analytic from
-        ``qcomm.wire_bytes`` at this engine's transport format, so the
-        quant-comm bench can diff bytes across passthrough/int8 twin runs.
-        ``reps``: identical dispatches to account at once (a step_n burst
-        is ``n`` decode ticks).  No-op without a TP mesh."""
+    def _account_comm(self, n_tokens: int, reps: int = 1,
+                      sample_rows: Optional[int] = None) -> None:
+        """Wire-byte accounting for ONE dispatch's TP collectives into the
+        ``comm/*`` counters, from the shared :mod:`comm.budget` plan (the
+        same enumeration the Graft Auditor checks against the compiled
+        HLO, so this accounting cannot silently drift from what XLA
+        emits).  ``bytes_on_wire`` counts the row-parallel transports at
+        this engine's format (the quant-comm bench diffs it across
+        passthrough/int8 twins); ``bytes_on_wire_overhead`` counts the
+        format-independent GSPMD wire (embedding combine, block-input and
+        head-input gathers).  ``reps``: identical dispatches to account at
+        once (a step_n burst is ``n`` decode ticks); ``sample_rows``:
+        rows the dispatch scores logits for (defaults to ``n_tokens`` —
+        packed prefill passes its slot count).  No-op without a TP mesh."""
         ctx = self.serving_ctx
         if self._mesh is None or ctx.size <= 1:
             return
-        from ..comm import qcomm
+        from ..comm import budget
 
-        n_red = 2 * self.cfg.num_layers
-        per = qcomm.wire_bytes(
-            "all_reduce", n_tokens * self.cfg.hidden_size, ctx.comm_fmt,
-            ctx.size,
-            none_bytes_per_el=jnp.dtype(self.cfg.dtype).itemsize,
+        plan = budget.serving_tick_plan(
+            self.cfg, n_tokens, ctx.size, ctx.comm_fmt,
+            tiles=max(ctx.comm_tiles, 1),
+            sample_rows=n_tokens if sample_rows is None else sample_rows,
         )
-        self._comm_c["bytes_on_wire"].inc(reps * n_red * per)
-        self._comm_c["collectives"].inc(reps * n_red * max(ctx.comm_tiles, 1))
+        self._comm_c["bytes_on_wire"].inc(
+            reps * budget.plan_bytes(plan, overhead=False))
+        self._comm_c["bytes_on_wire_overhead"].inc(
+            reps * budget.plan_bytes(plan, overhead=True))
+        # wire-op count: the plan's row group is already per-tile
+        n_ops = sum(p.count for p in plan if p.label == "row_psum")
+        self._comm_c["collectives"].inc(reps * n_ops)
 
     def measure_tp_collectives(self, reps: int = 8,
                                fmt: Optional[str] = None,
@@ -991,12 +1007,19 @@ class InferenceEngineV2:
         from ..parallel.sharding import shard_map_compat
         from ..parallel.topology import MODEL_AXIS
 
+        from ..comm import budget as _budget
+
         cfg, tp = self.cfg, self.serving_ctx.size
         fmt = fmt if fmt is not None else self.serving_ctx.comm_fmt
         tiles = tiles if tiles is not None else self.serving_ctx.comm_tiles
-        B, d, L = self.mgr.max_seqs, cfg.hidden_size, cfg.num_layers
+        B, d = self.mgr.max_seqs, cfg.hidden_size
         v = (cfg.vocab_size // tp) * tp  # sharded-head rows, pad-free
-        n_red = 2 * L
+        # the measured chain replays the budget plan's row-parallel group
+        # (comm/budget.py) — the same enumeration _account_comm and the
+        # Graft Auditor use, so the microbenchmark and the accounting
+        # cannot drift apart
+        n_red = sum(p.count for p in _budget.serving_tick_plan(
+            cfg, B, tp, fmt) if p.label == "row_psum")
 
         def body(xs, lg):
             def step(c, x):

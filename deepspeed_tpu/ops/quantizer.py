@@ -306,10 +306,15 @@ def _shard_mm(x2d, w, bias, kind: str, ctx: ServingContext):
                 if lo >= hi:
                     break
                 y_i = _mm_local(xl, _slice_out(local_w, lo, hi), None, fused)
-                outs.append(qcomm.q_all_reduce(
-                    y_i, ax, ctx.comm_fmt, world=n_sh,
-                ).astype(y_i.dtype) if ctx.comm_fmt != "none"
-                    else jax.lax.psum(y_i, ax))
+                # per-tile transport through qcomm (tiles=1: THIS loop is
+                # the tiling) — exact lax.psum in passthrough, quantized
+                # EQuARX all-reduce otherwise; routing the passthrough
+                # through qcomm too keeps the fmt='none' A/B lever and the
+                # auditor's source-based transport attribution universal
+                outs.append(qcomm.q_psum_tiled(
+                    y_i, ax, ctx.comm_fmt, tiles=1, world=n_sh,
+                    out_dtype=y_i.dtype,
+                ))
             return jnp.concatenate(outs, axis=-1)
         y = _mm_local(xl, local_w, bl, fused)
         if kind == "row":

@@ -112,9 +112,18 @@ def infer_tp_rules(
             v_dims = [i for i, d in enumerate(shape)
                       if vocab_size and d == vocab_size and divides(d)]
             if v_dims:
-                entry[v_dims[0]] = MODEL_AXIS
+                # ambiguous square kernels (hidden == vocab_size): an
+                # lm-head-style kernel is [..., in, vocab] — its vocab dim
+                # is the TRAILING one — while an embedding table is
+                # [vocab, d].  Picking the first match blindly sharded a
+                # square head's IN features, which GSPMD then repaired
+                # with a per-dispatch weight all-to-all (caught by the
+                # Graft Auditor's collective budget).
+                pick = (v_dims[-1] if re.search(r"head", lower)
+                        else v_dims[0])
+                entry[pick] = MODEL_AXIS
                 rules.append((f"^{re.escape(path)}$", P(*entry)))
-                if v_dims[0] == len(shape) - 1:  # out-dim sharded (lm head)
+                if pick == len(shape) - 1:  # out-dim sharded (lm head)
                     col_parent_dirs[path.rsplit("/", 1)[0]] = True
             continue
         if any(re.search(p, lower) for p in ROW_PATTERNS):

@@ -6,8 +6,6 @@ with quantized collectives), ``tests/onebit/`` (compressed optimizer
 correctness).  The comm-payload A/B check inspects the lowered HLO for int8
 collectives — the CPU-mesh analogue of counting bytes on the wire.
 """
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -153,19 +151,23 @@ def test_zeropp_int8_on_the_wire():
     b = random_batches(1, 1, 16)[0]
     batch = {k: v.reshape((1,) + v.shape[1:]) if v.ndim == 2 else v for k, v in b.items()}
 
-    def hlo_of(eng):
+    def colls_of(eng):
+        from deepspeed_tpu.analysis import stablehlo_collectives
+
         step = eng._get_train_step(b)
         import jax as _j
 
-        return step.lower(eng.state, b, _j.random.PRNGKey(0)).as_text()
+        return stablehlo_collectives(
+            step.lower(eng.state, b, _j.random.PRNGKey(0)).as_text()
+        )
 
-    hlo_q = hlo_of(eng_q)
-    hlo_d = hlo_of(eng_d)
-    s8_coll_q = re.findall(r'"(?:all_gather|all_to_all)[^"]*"[^\n]*tensor<[0-9x]*i8>', hlo_q)
-    # stablehlo prints collectives as ops; search for i8 operands on them
-    assert "i8" in hlo_q, "quantized path must carry int8 payloads"
-    n_q = len(re.findall(r"all_gather.*i8|all_to_all.*i8", hlo_q))
-    n_d = len(re.findall(r"all_gather.*i8|all_to_all.*i8", hlo_d))
+    def n_int8(colls):
+        return sum(1 for c in colls
+                   if c.kind in ("all_gather", "all_to_all")
+                   and c.dtype == "i8")
+
+    n_q = n_int8(colls_of(eng_q))
+    n_d = n_int8(colls_of(eng_d))
     assert n_q > 0, "expected int8 collectives in the ZeRO++ graph"
     assert n_d == 0, "dense graph must not carry int8 collectives"
 
@@ -223,11 +225,16 @@ def test_onebit_compressed_phase_trains(opt_type):
 
 
 def test_onebit_int8_on_the_wire():
+    from deepspeed_tpu.analysis import stablehlo_collectives
+
     eng = _onebit_engine(freeze_step=0)
     b = random_batches(1, 1, 16)[0]
     step = eng._get_train_step(b)
-    hlo = step.lower(eng.state, b, jax.random.PRNGKey(0)).as_text()
-    assert len(re.findall(r"all_gather.*i8|all_to_all.*i8", hlo)) > 0
+    colls = stablehlo_collectives(
+        step.lower(eng.state, b, jax.random.PRNGKey(0)).as_text()
+    )
+    assert any(c.kind in ("all_gather", "all_to_all") and c.dtype == "i8"
+               for c in colls)
 
 
 def test_onebit_direct_build_raises():

@@ -11,12 +11,11 @@ interpreter on the virtual 8-device CPU mesh.
 Covered here: region parity against the single-device jnp reference at
 410M- and 8B-layer shapes (int8/fp8/fp6 x bias/no-bias x col/row), greedy
 decode token identity of a TP engine vs the single-chip engine with fused
-kernels ON IN BOTH, and the compiled-HLO placement claims (no all-gather of
-quantized weight operands in the decode jit; exactly one psum per
-row-parallel projection).  Heavy shapes/configs are slow-marked.
+kernels ON IN BOTH, and the compiled-program placement claims (no
+all-gather of quantized weight operands in the decode jit; exactly one
+psum per row-parallel projection — asserted on the Graft Auditor's typed
+records, not HLO text regexes).  Heavy shapes/configs are slow-marked.
 """
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -215,10 +214,14 @@ def test_tp_decode_token_identity_more_formats(fmt, tp):
 
 
 def test_decode_hlo_no_weight_gather_one_psum_per_row_projection():
-    """ACCEPTANCE (compiled HLO text): the decode jit under TP contains NO
-    all-gather of a quantized (s8/u8) weight operand, and exactly one
-    all-reduce of the [B, hidden] partial products per row-parallel
-    projection (o + down = 2 per layer)."""
+    """ACCEPTANCE (compiled program, typed records): the decode jit under
+    TP contains NO all-gather of a quantized (s8/u8) weight payload, and
+    exactly one all-reduce of the [B, hidden] partial products per
+    row-parallel projection (o + down = 2 per layer) — identified by its
+    qcomm.py source metadata, which excludes GSPMD-inserted collectives
+    (the vocab-sharded embedding combine is also an f32[B, hidden]
+    all-reduce)."""
+    from deepspeed_tpu.analysis import program_facts
     from deepspeed_tpu.inference import InferenceEngineV2, model_runner
     from deepspeed_tpu.models import CausalLM
 
@@ -240,25 +243,22 @@ def test_decode_hlo_no_weight_gather_one_psum_per_row_projection():
     lens = jnp.ones(B, jnp.int32)
     bt = jnp.zeros((B, eng.max_pages), jnp.int32)
     act = jnp.ones(B, bool)
-    txt = jax.jit(dec).lower(
-        eng.params, toks, lens, bt, act, eng.kv
-    ).compile().as_text()
-    gathers = [l for l in txt.splitlines() if re.search(r"all-gather[^_]", l)]
-    assert not any("s8[" in l or "u8[" in l for l in gathers), (
-        "quantized weight operand all-gathered:\n" +
-        "\n".join(l for l in gathers if "s8[" in l or "u8[" in l))
-    # our region psums carry qcomm.py source metadata (the row-parallel
-    # transport moved from an inline lax.psum in quantizer.py into
-    # qcomm.q_psum_tiled) — this excludes GSPMD-inserted collectives (e.g.
-    # the vocab-sharded embedding gather's combine, which is also an
-    # f32[B, hidden] all-reduce)
+    facts = program_facts(
+        jax.jit(dec), eng.params, toks, lens, bt, act, eng.kv
+    )
+    bad = [c for c in facts.find(kind="all-gather")
+           if c.dtype in ("s8", "u8")]
+    assert not bad, (
+        "quantized weight operand all-gathered:\n"
+        + "\n".join(c.line[:140] for c in bad))
     row_psums = [
-        l for l in txt.splitlines()
-        if re.search(rf"= f32\[{B},{cfg.hidden_size}\]\S* all-reduce\(", l)
-        and ("qcomm.py" in l or "quantizer.py" in l)
+        c for c in facts.find(kind="all-reduce",
+                              source_file=("qcomm.py",))
+        if c.shape == (B, cfg.hidden_size)
     ]
     assert len(row_psums) == 2 * cfg.num_layers, (
-        len(row_psums), 2 * cfg.num_layers, row_psums)
+        len(row_psums), 2 * cfg.num_layers,
+        [c.line[:120] for c in row_psums])
 
 
 def test_tp_allreduce_telemetry_measured():
