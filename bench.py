@@ -484,6 +484,220 @@ def chaos_serve_main(smoke=False):
     assert availability == 1.0, f"healthy requests lost: {availability}"
 
 
+def router_serve_main(smoke=False, chaos=False):
+    """Serve-front-end bench (`python bench.py --serving --router [--chaos]
+    [--smoke]`): the disaggregated router over N engine workers
+    (deepspeed_tpu/serving/).  Three claims, each asserted:
+
+    - **Prefix-affinity routing** recovers a NONZERO aggregate prefix hit
+      rate across >= 2 workers — vs exactly 0 for today's
+      ``serve_replicas > 1`` path, whose 2-D mesh gates prefix caching off
+      entirely.  On the CPU sizes the routed results are also asserted
+      token-identical to a single-engine reference run.
+    - **Paged-KV handoff** (prefill/decode disaggregation) round-trips
+      token-identically in BOTH wire formats: exact ``fmt='none'`` pages
+      and qcomm's int8 per-chunk-scale payload (~4x fewer bytes).
+    - **Chaos availability** (``--chaos``): under the PR 6 fault storm PLUS
+      a worker-kill injection, every healthy request still reaches
+      FINISHED — requests on the dead worker re-route and replay from the
+      prompt — so availability >= the single-engine chaos baseline run in
+      the same process.
+
+    Also gated: per-worker telemetry namespaces stay distinct (serve /
+    serve2 / ...) and every worker tears down zero-leak through
+    ``engine.close()``."""
+    from deepspeed_tpu.inference.engine_v2 import build_serve_engine
+    from deepspeed_tpu.inference.faults import FaultInjector
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.serving import build_router
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and not smoke:
+        cfg = get_preset("llama3_proxy_410m")
+        params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.bfloat16)
+        n_req, sys_len, sfx_len, max_new, long_len = 48, 128, 32, 24, 512
+        sec = dict(max_seqs=8, num_blocks=192, block_size=32, max_seq_len=704,
+                   prefill_buckets=[64, 128, 256, 512], prefill_budget=512,
+                   enable_prefix_caching=True)
+        check_identity = False  # bf16 greedy near-ties may flip
+    else:
+        cfg = get_preset("tiny", max_seq_len=256, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+        n_req, sys_len, sfx_len, max_new, long_len = 12, 16, 8, 8, 48
+        sec = dict(max_seqs=4, num_blocks=96, block_size=8, max_seq_len=256,
+                   prefill_buckets=[16, 32, 64, 128],
+                   enable_prefix_caching=True)
+        check_identity = True
+    samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
+    rng = np.random.default_rng(0)
+    # mixed traffic: half the requests share a system prompt (the affinity
+    # population), half are cold unique prompts (the balance population)
+    sys_prompt = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+    prompts = {}
+    for u in range(1, n_req + 1):
+        sfx = rng.integers(1, cfg.vocab_size, sfx_len).tolist()
+        prompts[u] = (sys_prompt + sfx if u % 2 else
+                      rng.integers(1, cfg.vocab_size, sys_len).tolist() + sfx)
+    long_prompt = rng.integers(1, cfg.vocab_size, long_len).tolist()
+
+    def drive_single(eng, want_uids):
+        sched = eng.scheduler
+        for u in want_uids:
+            assert sched.try_submit(u, prompts[u], samp).accepted
+        res = sched.run()
+        return {u: (sched.requests[u].state, sched.pop_result(u))
+                for u in want_uids}
+
+    # --- single-engine reference: tokens + the R=1 hit rate ----------------
+    ref = build_serve_engine(params, cfg, sec)
+    t0 = time.perf_counter()
+    want = drive_single(ref, list(prompts))
+    single_dt = time.perf_counter() - t0
+    single_hit = (ref.mgr.cached_prompt_tokens
+                  / max(ref.mgr.prompt_tokens_total, 1))
+    want_long = ref.generate(long_prompt, samp)
+    ref.close()
+
+    # --- routed run over 2 workers: affinity recovers the hit rate ---------
+    router = build_router(params, cfg, sec, router=dict(n_workers=2))
+    for u in prompts:
+        assert router.try_submit(u, prompts[u], samp).accepted
+    t0 = time.perf_counter()
+    out = router.run()
+    router_dt = time.perf_counter() - t0
+    hit_rate = router.prefix_hit_rate()
+    rstats = dict(router.stats)
+    namespaces = [w.ns for w in router.pool.workers]
+    total_tokens = sum(len(p) for p in prompts.values()) + sum(
+        len(t) for _, t in out.values())
+    routed_identical = None
+    if check_identity:
+        routed_identical = all(
+            out[u] == ("finished", want[u][1]) for u in prompts)
+        assert routed_identical, "routed tokens diverged from single engine"
+    assert hit_rate > 0.0, "affinity routing recovered no prefix hits"
+    assert len(set(namespaces)) == len(namespaces), namespaces
+    audits = router.close()
+    assert all(a["blocks_in_use"] == 0 for a in audits), audits
+
+    # --- KV handoff round trip: exact and int8 wire ------------------------
+    handoff = {}
+    for fmt in ("none", "int8"):
+        r2 = build_router(
+            params, cfg, sec,
+            router=dict(n_workers=3, prefill_workers=1,
+                        disagg_threshold=min(long_len, sys_len + sfx_len),
+                        handoff_fmt=fmt),
+        )
+        r2.submit(1, long_prompt, samp)
+        h_out = r2.run()
+        s2 = dict(r2.stats)
+        identical = (not check_identity) or h_out[1] == ("finished", want_long)
+        assert s2["handoffs"] == 1, s2
+        assert identical, f"KV handoff ({fmt}) changed greedy tokens"
+        handoff[fmt] = {"wire_bytes": s2["handoff_wire_bytes"],
+                        "token_identical": identical}
+        a2 = r2.close()
+        assert all(a["blocks_in_use"] == 0 for a in a2), a2
+    handoff["int8_wire_saving"] = round(
+        1 - handoff["int8"]["wire_bytes"]
+        / max(handoff["none"]["wire_bytes"], 1), 3)
+
+    # --- chaos: fault storm + worker kill vs single-engine baseline --------
+    chaos_extra = None
+    if chaos:
+        serve_kw = dict(max_retries=4, retry_backoff_ms=1.0,
+                        shed_queue_depth=max(2, n_req // 4))
+        nan_victims, fatal_victims = [5, 9], [3]
+        injected = set(nan_victims) | set(fatal_victims)
+
+        def storm_injector():
+            return (FaultInjector(seed=0)
+                    .arm("runner_exception", p=0.05, transient=True)
+                    .arm("runner_exception", uids=fatal_victims)
+                    .arm("nan_logits", uids=nan_victims,
+                         times=len(nan_victims))
+                    .arm("alloc_exhaustion", p=0.05, transient=True, times=8)
+                    .arm("slow_tick", p=0.1, delay_s=0.002, times=10))
+
+        def availability(results):
+            healthy = [u for u in prompts if u not in injected]
+            done = [u for u in healthy if results[u][0] == "finished"]
+            return len(done) / len(healthy)
+
+        base_eng = build_serve_engine(params, cfg, sec, serve=serve_kw,
+                                      faults=storm_injector())
+        base_out = drive_single(base_eng, list(prompts))
+        base_avail = availability(base_out)
+        base_eng.close()
+
+        kill_inj = FaultInjector(seed=1).arm(
+            "worker_kill", uids=[1], after=4, times=1)
+        r3 = build_router(params, cfg, sec, router=dict(n_workers=2),
+                          serve=serve_kw, faults=kill_inj,
+                          engine_faults=storm_injector())
+        backlog = []
+        for u in prompts:
+            res = r3.try_submit(u, prompts[u], samp)
+            if not res.accepted:
+                backlog.append(u)
+        ticks = 0
+        while backlog or not r3.idle:
+            if backlog:
+                res = r3.try_submit(backlog[0], prompts[backlog[0]], samp)
+                if res.accepted:
+                    backlog.pop(0)
+            r3.tick()
+            ticks += 1
+            if ticks > 100_000:
+                raise RuntimeError("router chaos loop did not converge")
+        storm_out = {u: r3.pop_result(u) for u in prompts}
+        storm_avail = availability(storm_out)
+        s3 = dict(r3.stats)
+        a3 = r3.close()
+        assert all(a["blocks_in_use"] == 0 for a in a3), a3
+        assert s3["worker_deaths"] == 1, s3
+        assert storm_avail >= base_avail, (storm_avail, base_avail)
+        replay_identical = None
+        if check_identity:
+            replay_identical = all(
+                storm_out[u][1] == want[u][1] for u in prompts
+                if u not in injected and storm_out[u][0] == "finished")
+            assert replay_identical, "replayed tokens diverged"
+        chaos_extra = {
+            "availability": round(storm_avail, 4),
+            "single_engine_baseline_availability": round(base_avail, 4),
+            "worker_deaths": s3["worker_deaths"],
+            "replays": s3["replays"],
+            "worker_retry_later": s3["worker_retry_later"],
+            "healthy_tokens_match_fault_free": replay_identical,
+        }
+
+    print(json.dumps({
+        "metric": "serve_router_prefix_hit_rate",
+        "value": round(hit_rate, 4),
+        "unit": "fraction",
+        "vs_baseline": None,
+        "extra": {
+            "workers": 2, "requests": n_req,
+            "replicated_gated_hit_rate": 0.0,  # serve_replicas>1 today
+            "single_engine_hit_rate": round(single_hit, 4),
+            "routed_tokens_per_sec": round(total_tokens / router_dt, 1),
+            "single_engine_tokens_per_sec": round(
+                total_tokens / single_dt, 1),
+            "routed_token_identical": routed_identical,
+            "routed_affinity": rstats["routed_affinity"],
+            "routed_least_loaded": rstats["routed_least_loaded"],
+            "worker_namespaces": namespaces,
+            "allocator_leak_check": "pass",
+            "kv_handoff": handoff,
+            "chaos": chaos_extra,
+        },
+    }))
+
+
 def serving_main(quant=None, spec=False, smoke=False):
     """Serving throughput: continuous-batching decode at batch 64 on one
     chip (`python bench.py --serving [--quant int8|fp8]`).  Prints one JSON
@@ -1795,6 +2009,8 @@ if __name__ == "__main__":
             autotune_training_main(smoke=smoke, out=out)
         else:  # serving is the default search (the knob-rich surface)
             autotune_serving_main(smoke=smoke, out=out)
+    elif "--serving" in sys.argv and "--router" in sys.argv:
+        router_serve_main(smoke=smoke, chaos="--chaos" in sys.argv)
     elif "--serving" in sys.argv and "--chaos" in sys.argv:
         chaos_serve_main(smoke=smoke)
     elif "--serving" in sys.argv:

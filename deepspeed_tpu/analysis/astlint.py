@@ -46,6 +46,15 @@ HOT_PATHS: Dict[str, Set[str]] = {
     },
     # the serve loop's per-tick driver
     "inference/scheduler.py": {"tick"},
+    # the router front end's control loop + its load-signal reads: router
+    # instrumentation must never add a device round trip to a worker's tick
+    # (each engine already owns its one designed np.asarray fetch), and the
+    # KV-handoff codec runs host-side numpy by design
+    "serving/router.py": {"tick", "try_submit", "_route", "_candidates",
+                          "_maybe_migrate", "_kill_worker", "_finish"},
+    "serving/handoff.py": {"extract_request", "inject_request"},
+    "serving/pool.py": {"load", "queue_depth", "running", "headroom_blocks",
+                        "shedding"},
     # traced model code: a host sync here is a trace-time bug by definition
     "inference/model_runner.py": {"*"},
     "inference/sampling.py": {"*"},

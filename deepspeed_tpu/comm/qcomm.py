@@ -450,3 +450,71 @@ def error_like(x) -> jnp.ndarray:
     """Zero-initialized error-feedback buffer for ``x`` (fp32, same shape).
     Persist it across steps and thread it through ``error=``."""
     return jnp.zeros(getattr(x, "shape", ()), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# host-side payload codec (the paged-KV handoff wire format)
+# ---------------------------------------------------------------------------
+def quantize_payload(arr, fmt: str, chunk: int = DEFAULT_CHUNK):
+    """Encode a host array into qcomm's per-chunk-scale wire format:
+    ``(payload, scales)`` where ``payload`` is int8 (or fp8-as-uint8 bytes)
+    of ``arr`` flattened into ``chunk``-element groups and ``scales`` is one
+    fp32 amax scale per group — exactly the layout the collectives put on
+    the wire, but computed in numpy so a ROUTER process packing a paged-KV
+    handoff never touches a device.  ``fmt='none'`` passes through
+    ``(arr, None)``.  Decode with :func:`dequantize_payload`."""
+    import numpy as np
+
+    _check_fmt(fmt)
+    if fmt == "none":
+        return np.asarray(arr), None
+    flat = np.asarray(arr, np.float32).reshape(-1)
+    pad = (-flat.shape[0]) % chunk
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    buf = flat.reshape(-1, chunk)
+    amax = np.max(np.abs(buf), axis=-1)
+    s = (np.maximum(amax, 1e-12) / _FMT_MAX[fmt]).astype(np.float32)
+    if fmt == "int8":
+        q = np.clip(np.round(buf / s[:, None]), -127, 127).astype(np.int8)
+    else:
+        # fp8 payloads cross the host boundary as their raw e4m3 bytes;
+        # ml_dtypes (a jax dependency) casts in PURE numpy — the codec must
+        # never touch a device (a router process packing a handoff has none)
+        import ml_dtypes
+
+        q = (buf / s[:, None]).astype(ml_dtypes.float8_e4m3fn).view(np.uint8)
+    return q, s
+
+
+def dequantize_payload(q, s, shape, dtype, fmt: str,
+                       chunk: int = DEFAULT_CHUNK):
+    """Decode a :func:`quantize_payload` pair back into an array of
+    ``shape``/``dtype``.  Exact inverse layout: dequantized fp32 groups are
+    un-padded and reshaped; ``fmt='none'`` casts the passthrough payload."""
+    import numpy as np
+
+    _check_fmt(fmt)
+    if fmt == "none":
+        return np.asarray(q).reshape(shape).astype(dtype)
+    if fmt == "int8":
+        buf = q.astype(np.float32) * s[:, None]
+    else:
+        import ml_dtypes
+
+        buf = q.view(ml_dtypes.float8_e4m3fn).astype(np.float32) * s[:, None]
+    n = int(np.prod(shape))
+    return buf.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def payload_wire_bytes(n_elements: int, fmt: str, chunk: int = DEFAULT_CHUNK,
+                       none_bytes_per_el: int = 2) -> int:
+    """Bytes ONE :func:`quantize_payload` encoding puts on a wire (payload
+    + fp32 scales) — the handoff counterpart of :func:`wire_bytes` (which
+    counts ring-collective sends, not point-to-point transfers).
+    ``none_bytes_per_el`` defaults to 2: passthrough KV pages ship in the
+    cache compute dtype (bf16)."""
+    _check_fmt(fmt)
+    if fmt == "none":
+        return n_elements * none_bytes_per_el
+    return n_elements * _FMT_BYTES[fmt] + 4 * (-(-n_elements // chunk))

@@ -350,6 +350,79 @@ class ServeEngineConfig:
 
 
 @dataclass
+class RouterConfig:
+    """Serve-front-end knobs (``serving/`` — the disaggregated request
+    router over N engine workers).  Consumed by ``serving.Router`` /
+    ``serving.build_router``; one validated block so benches, tests and
+    launchers spell the routing policy the same way.
+
+    ``n_workers``: engine workers the pool stamps out (each via
+    ``build_serve_engine`` from one ``ServeEngineConfig``);
+    ``prefill_workers``: the first K workers take the PREFILL role — long
+    prompts land there and migrate to a decode worker at first token via
+    the paged-KV handoff (0 disables disaggregation).
+    ``disagg_threshold``: prompt length (tokens) from which a request
+    counts as long (None = the engine's prefill chunk).
+    ``handoff_fmt``: KV-handoff wire format — 'none' ships pages in the
+    cache dtype (token-exact), 'int8'/'fp8' quantize per qcomm's
+    per-chunk-scale payload codec (~half/quarter the bytes, lossy within
+    the same tolerance as quantized collectives).
+    ``affinity``: prefix-affinity routing — chained full-block content
+    hashes map a prompt's shared prefix to the worker already holding its
+    blocks (fall back: least-loaded); ``affinity_max_keys`` bounds the
+    router's hash->worker map (LRU).
+    ``shed_queue_depth``: router-side backlog depth that sheds new
+    submissions at the front door with typed RETRY_LATER (None = never).
+    ``max_replays``: times a request may re-route and replay from its
+    prompt after a worker death before it is failed.
+    ``retry_backoff_ms``: fallback backoff when a worker rejects
+    RETRY_LATER without a ``retry_after_ms`` hint."""
+
+    n_workers: int = 2
+    prefill_workers: int = 0
+    disagg_threshold: Optional[int] = None
+    handoff_fmt: str = "none"
+    affinity: bool = True
+    affinity_max_keys: int = 8192
+    shed_queue_depth: Optional[int] = None
+    max_replays: int = 3
+    retry_backoff_ms: float = 20.0
+
+    def __post_init__(self):
+        if self.n_workers < 1:
+            raise ConfigError(
+                f"router.n_workers must be >= 1, got {self.n_workers}")
+        if not 0 <= self.prefill_workers < self.n_workers:
+            raise ConfigError(
+                f"router.prefill_workers must be in [0, n_workers), got "
+                f"{self.prefill_workers} of {self.n_workers} (at least one "
+                "decode-capable worker must remain)")
+        if self.handoff_fmt not in ("none", "int8", "fp8"):
+            raise ConfigError(
+                f"router.handoff_fmt must be none|int8|fp8, got "
+                f"{self.handoff_fmt!r}")
+        if self.disagg_threshold is not None and self.disagg_threshold < 1:
+            raise ConfigError(
+                f"router.disagg_threshold must be >= 1 or None, got "
+                f"{self.disagg_threshold}")
+        if self.affinity_max_keys < 1:
+            raise ConfigError(
+                f"router.affinity_max_keys must be >= 1, got "
+                f"{self.affinity_max_keys}")
+        if self.shed_queue_depth is not None and self.shed_queue_depth < 1:
+            raise ConfigError(
+                f"router.shed_queue_depth must be >= 1 or None, got "
+                f"{self.shed_queue_depth}")
+        if self.max_replays < 0:
+            raise ConfigError(
+                f"router.max_replays must be >= 0, got {self.max_replays}")
+        if self.retry_backoff_ms < 0:
+            raise ConfigError(
+                f"router.retry_backoff_ms must be >= 0, got "
+                f"{self.retry_backoff_ms}")
+
+
+@dataclass
 class AutotuneConfig:
     """Autotuner knobs (``autotuning/`` — the roofline-seeded config
     search).  Consumed by the offline entrypoints (``bench.py --autotune``,

@@ -113,13 +113,14 @@ class InferenceEngineV2:
                     "features with serve_replicas=1 (the multi-replica "
                     "router PR lifts this)"
                 )
-            # NOTE: the scheduler still CHUNKS a prompt longer than the
-            # largest prefill bucket (and a long preempted requeue) even
-            # with prefill_chunk unset — those continuation packs run
-            # prefill_packed_ctx, whose dense ctx gather crosses the
-            # batch-sharded pool under GSPMD.  Correct (CPU-verified
-            # bit-identical) but not replica-local: keep over-budget
-            # prompts off dp>1 engines where that matters.
+            # Over-budget prompts are fully closed off at dp > 1: the
+            # scheduler rejects them with a typed SubmitResult
+            # (REJECT_PROMPT_OVER_BUDGET covers the worst-case requeue
+            # length too), and _run_packed_prefill refuses any ctx pack
+            # outright — prefill_packed_ctx's dense ctx gather would cross
+            # the batch-sharded pool under GSPMD (correct but not
+            # replica-local; the router front end is the sanctioned way to
+            # scale replicas with the full feature set).
         self.serve_replicas = dp
         # Quantized-weight serving (reference csrc/fp_quantizer + FP6 blog
         # 1.69-2.65x claim): big matmul kernels stored int8/fp8 with per-
@@ -382,6 +383,9 @@ class InferenceEngineV2:
         # where no sequence changed its sampling skip the H2D copy
         self._samp_np = np.full((max_seqs, 2), np.nan, np.float32)
         self._samp_dev = None
+        # lazily-built paged-KV handoff dispatches (extract/inject_kv_blocks)
+        self._kv_gather_jit = None
+        self._kv_scatter_jit = None
 
         # params are explicit jit arguments — closing over them would inline
         # every weight into the HLO as a constant (huge programs, no donation)
@@ -805,6 +809,14 @@ class InferenceEngineV2:
                 f"prefill bucket {t_pad} must be a multiple of block_size {bs}"
             )
         use_ctx = any(start > 0 for _, start, _ in entries)
+        if use_ctx and self.serve_replicas > 1:
+            raise NotImplementedError(
+                "context-attention prefill packs are not replica-local: "
+                "their dense ctx gather crosses the batch-sharded KV pool "
+                "under GSPMD — over-budget/continuation prefill needs "
+                "serve_replicas=1 (route replica scale through "
+                "serving.Router instead)"
+            )
         tokens = np.zeros(t_pad, np.int32)
         seg = np.zeros(t_pad, np.int32)
         pos = np.zeros(t_pad, np.int32)
@@ -1497,6 +1509,75 @@ class InferenceEngineV2:
         for uid in uids:
             self.mgr.release(uid)
 
+    # -- paged-KV handoff (serving/handoff.py rides these) -------------------
+    @staticmethod
+    def _handoff_pad(n: int) -> int:
+        """Page counts rounded up to the next power of two: the handoff
+        gather/scatter jits then compile O(log pool) shapes total instead
+        of one per distinct migrated-prompt length — a mid-migration XLA
+        compile (the scatter donates the whole pool) stalls every worker's
+        tick."""
+        return 1 << (n - 1).bit_length() if n > 1 else n
+
+    def extract_kv_blocks(self, blocks: Sequence[int]):
+        """Device->host copy of a block range: per-layer ``(k, v)`` page
+        arrays ``[n_blocks, bs, hkv, hd]`` for ``blocks`` (GLOBAL ids, any
+        order).  One gather dispatch for the whole tree; the host copy is
+        the prefill half of a prefill/decode disaggregation handoff —
+        wire-format packing (optional int8 per-chunk-scale quantization) is
+        the router's job (comm.qcomm payload codec), not the engine's."""
+        if self._kv_gather_jit is None:
+            self._kv_gather_jit = jax.jit(
+                lambda kv, idx: jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, idx, axis=0), kv
+                )
+            )
+        idx = [int(b) for b in blocks]
+        n = len(idx)
+        idx += [idx[-1]] * (self._handoff_pad(n) - n)
+        pages = self._kv_gather_jit(self.kv, jnp.asarray(idx, jnp.int32))
+        return jax.tree_util.tree_map(lambda c: np.asarray(c)[:n], pages)
+
+    def inject_kv_blocks(self, blocks: Sequence[int], pages) -> None:
+        """Scatter extracted pages into THIS engine's pool at ``blocks``
+        (the decode half of the handoff).  ``pages`` is the
+        :meth:`extract_kv_blocks` tree (host arrays; device arrays are
+        copied back through the host — the handoff path is host-mediated
+        anyway); the pool is donated so the write is in place, and on a TP
+        mesh the result shardings are pinned so the pool stays sharded
+        across the update.  The caller owns ``blocks`` (freshly allocated,
+        refcount 1) — this never consults the allocator."""
+        if self._kv_scatter_jit is None:
+            def scatter(kv, idx, pay):
+                return jax.tree_util.tree_map(
+                    lambda c, p: c.at[idx].set(p.astype(c.dtype)), kv, pay
+                )
+
+            if self._kv_shardings is not None:
+                self._kv_scatter_jit = jax.jit(
+                    scatter, donate_argnums=(0,),
+                    out_shardings=self._kv_shardings,
+                )
+            else:
+                self._kv_scatter_jit = jax.jit(scatter, donate_argnums=(0,))
+        idx = [int(b) for b in blocks]
+        n = len(idx)
+        pad = self._handoff_pad(n) - n
+        if pad:
+            # duplicate-index scatter of IDENTICAL content: whichever
+            # duplicate wins, the page's bits are the same
+            idx += [idx[-1]] * pad
+            pages = jax.tree_util.tree_map(
+                lambda p: np.concatenate(
+                    [np.asarray(p),
+                     np.broadcast_to(np.asarray(p)[-1:],
+                                     (pad,) + np.asarray(p).shape[1:])]),
+                pages)
+        self.kv = self._kv_scatter_jit(
+            self.kv, jnp.asarray(idx, jnp.int32),
+            jax.tree_util.tree_map(jnp.asarray, pages),
+        )
+
     # -- teardown -----------------------------------------------------------
     def close(self) -> Dict[str, int]:
         """Tear this engine down so another can be built in-process without
@@ -1534,7 +1615,8 @@ class InferenceEngineV2:
         self.mgr.cow_hook = None
         for attr in ("_packed_prefill_jit", "_packed_prefill_ctx_jit",
                      "_cow_jit", "_decode_jit", "_decode_burst_jit",
-                     "_spec_jit", "_tables_dev", "_samp_dev"):
+                     "_spec_jit", "_tables_dev", "_samp_dev",
+                     "_kv_gather_jit", "_kv_scatter_jit"):
             setattr(self, attr, None)
         self._closed = True
         return dict(self._close_audit)

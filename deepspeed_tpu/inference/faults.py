@@ -49,9 +49,14 @@ RUNNER_EXCEPTION = "runner_exception"
 NAN_LOGITS = "nan_logits"
 SLOW_TICK = "slow_tick"
 CHECKPOINT_CRASH = "checkpoint_crash"
+# router-scoped: kills a whole ENGINE WORKER (serving/router.py checks it
+# per worker per tick with uids=(worker_index,) — uids here are worker
+# indices, not request uids); the router must re-route and replay every
+# request the dead worker held
+WORKER_KILL = "worker_kill"
 
 POINTS = (ALLOC_EXHAUSTION, RUNNER_EXCEPTION, NAN_LOGITS, SLOW_TICK,
-          CHECKPOINT_CRASH)
+          CHECKPOINT_CRASH, WORKER_KILL)
 
 
 class InjectedFault(RuntimeError):

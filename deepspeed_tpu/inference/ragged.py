@@ -452,7 +452,13 @@ class StateManager:
             parent = b
         return blocks, keys
 
-    def admit(self, uid: int, prompt_tokens: List[int]) -> SequenceDescriptor:
+    def admit(self, uid: int, prompt_tokens: List[int],
+              match_prefix: bool = True) -> SequenceDescriptor:
+        """Track a new sequence.  ``match_prefix=False`` skips the prefix-
+        cache walk even when caching is enabled — the KV-handoff adoption
+        path (serving/handoff.py) needs exclusively-owned fresh pages to
+        scatter a migrated sequence's extracted KV into; sharing a cached
+        block there would stomp content other sequences are reading."""
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
         if self.free_slots == 0:
@@ -466,7 +472,7 @@ class StateManager:
                     key=lambda x: self.allocators[x].available_blocks)
         seq = SequenceDescriptor(uid=uid, slot=self._slot_groups[r].pop(0))
         seq.tokens = list(prompt_tokens)
-        if self.enable_prefix_caching:
+        if self.enable_prefix_caching and match_prefix:
             seq.blocks, seq.hashes = self._match_prefix(
                 seq.tokens, self.allocators[r])
             seq.cached_tokens = len(seq.blocks) * self.block_size

@@ -68,10 +68,10 @@ from .faults import is_transient
 from .sampling import SamplingParams
 
 WAITING, PREFILL, DECODE = "waiting", "prefill", "decode"
-FINISHED, FAILED, TIMED_OUT, CANCELLED = (
-    "finished", "failed", "timed_out", "cancelled"
+FINISHED, FAILED, TIMED_OUT, CANCELLED, MIGRATED = (
+    "finished", "failed", "timed_out", "cancelled", "migrated"
 )
-TERMINAL = frozenset((FINISHED, FAILED, TIMED_OUT, CANCELLED))
+TERMINAL = frozenset((FINISHED, FAILED, TIMED_OUT, CANCELLED, MIGRATED))
 
 # -- typed submission outcomes (front ends distinguish client error from
 # capacity without parsing exception strings) --------------------------------
@@ -79,13 +79,15 @@ QUEUED = "queued"
 REJECT_DUPLICATE_UID = "duplicate_uid"
 REJECT_EMPTY_PROMPT = "empty_prompt"
 REJECT_PROMPT_TOO_LONG = "prompt_too_long"
+REJECT_PROMPT_OVER_BUDGET = "prompt_over_budget"
 REJECT_POOL_IMPOSSIBLE = "pool_impossible"
 REJECT_SAMPLING_CONFLICT = "sampling_conflict"
 RETRY_LATER = "retry_later"
 # invalid-outright rejections (the caller's bug: retrying cannot help)
 CLIENT_ERRORS = frozenset((
     REJECT_DUPLICATE_UID, REJECT_EMPTY_PROMPT, REJECT_PROMPT_TOO_LONG,
-    REJECT_POOL_IMPOSSIBLE, REJECT_SAMPLING_CONFLICT,
+    REJECT_PROMPT_OVER_BUDGET, REJECT_POOL_IMPOSSIBLE,
+    REJECT_SAMPLING_CONFLICT,
 ))
 
 
@@ -93,11 +95,16 @@ CLIENT_ERRORS = frozenset((
 class SubmitResult:
     """Typed handle ``try_submit`` returns: ``accepted`` or a reason enum
     (``CLIENT_ERRORS`` member = invalid request; ``RETRY_LATER`` = shed
-    mode, back off and resubmit)."""
+    mode, back off and resubmit).  ``retry_after_ms`` accompanies
+    ``RETRY_LATER``: the scheduler's drain-rate estimate of when a resubmit
+    has a chance (queue excess over the shed-exit watermark x the recent
+    tick duration) — clients back off proportionally instead of
+    blind-polling."""
 
     uid: int
     reason: str
     detail: str = ""
+    retry_after_ms: Optional[float] = None
 
     @property
     def accepted(self) -> bool:
@@ -181,7 +188,10 @@ class ServeScheduler:
             "submitted", "finished", "admissions",
             "preemptions", "queue_wait_ticks", "prefill_chunks",
             "drafts_shed",  # draft sets dropped under pool pressure
+            "migrated",  # requests detached to another worker (KV handoff)
+            "adopted",  # requests adopted mid-flight (the receiving side)
         ))
+        self._tick_ms_ema: Optional[float] = None  # retry_after_ms basis
         # fault-tolerance transitions count in the paired SERVE namespace
         # (they are serve-level events; the engine's stats view lists them
         # too — registry counters are memoized by name, so these are the
@@ -231,6 +241,21 @@ class ServeScheduler:
         # plus full generation budget — or decode growth eventually exhausts
         # the pool with no victim left to preempt and the whole loop dies.
         max_len = min(len(tokens) + sampling.max_new_tokens, eng.max_seq_len)
+        if eng.serve_replicas > 1 and max_len > eng.prefill_budget:
+            # a prompt (or its worst-case preempted requeue, which
+            # re-prefills prompt + everything generated) longer than one
+            # pack's budget would chunk into context-attention packs whose
+            # dense ctx gather crosses the batch-sharded pool — typed
+            # refusal instead of a silent cross-replica gather (route
+            # replica scale through serving.Router for the full feature
+            # set)
+            return SubmitResult(
+                uid, REJECT_PROMPT_OVER_BUDGET,
+                f"prompt + max_new_tokens ({max_len}) exceeds the prefill "
+                f"budget ({eng.prefill_budget}) on a serve_replicas="
+                f"{eng.serve_replicas} engine: continuation prefill packs "
+                "are not replica-local",
+            )
         blocks = -(-max_len // eng.block_size)
         # a sequence lives entirely inside ONE replica's block range, so the
         # feasibility bound is the per-replica pool, not the aggregate
@@ -259,6 +284,7 @@ class ServeScheduler:
                 uid, RETRY_LATER,
                 "scheduler is shedding load (queue backlog / watchdog); "
                 "retry later",
+                retry_after_ms=self.retry_after_ms(),
             )
         req = ServeRequest(uid=uid, prompt=tokens, sampling=sampling,
                            tokens=list(tokens), submit_tick=self.tick_no,
@@ -327,6 +353,8 @@ class ServeScheduler:
             self._flt["timed_out"].inc()
         elif state == CANCELLED:
             self._flt["cancelled"].inc()
+        elif state == MIGRATED:
+            self._c["migrated"].inc()
         req.trace.finished(outcome=state)
 
     def _fail(self, req: ServeRequest, error: str, nan: bool = False) -> None:
@@ -346,6 +374,140 @@ class ServeScheduler:
         if req is None or req.state in TERMINAL:
             return False
         self._release(req, CANCELLED)
+        return True
+
+    # -- prefill/decode disaggregation (the KV-handoff seam) -----------------
+    def adopt_prefilled(
+        self, uid: int, tokens: Sequence[int], n_ctx: int,
+        sampling: SamplingParams = SamplingParams(),
+        deadline_ms: Optional[float] = None,
+        ttft_deadline_ms: Optional[float] = None,
+    ) -> SubmitResult:
+        """Adopt a request another worker already prefilled: admit
+        ``tokens`` (= prompt + the first sampled token) straight into the
+        DECODE state with ``n_ctx`` tokens' KV assumed present.  NEVER
+        raises — returns a :class:`SubmitResult` (``RETRY_LATER`` when this
+        worker has no room; the router then leaves the request decoding
+        where it was).
+
+        On success the sequence holds freshly-allocated, EXCLUSIVELY-owned
+        pages (no prefix-cache sharing: the caller is about to scatter
+        migrated KV into them via ``engine.inject_kv_blocks``) and
+        ``seen_tokens = n_ctx``; the caller must inject the extracted pages
+        for positions ``[0, n_ctx)`` before the next tick, then publish the
+        prefix chain with ``mgr.update_hashes`` (serving/handoff.py wraps
+        both)."""
+        tokens = [int(t) for t in tokens]
+        if uid in self.requests or uid in self.engine.mgr.seqs:
+            return SubmitResult(uid, REJECT_DUPLICATE_UID,
+                                f"uid {uid} already in use")
+        if not 0 < n_ctx < len(tokens):
+            return SubmitResult(
+                uid, REJECT_EMPTY_PROMPT,
+                f"adoption needs 0 < n_ctx ({n_ctx}) < len(tokens) "
+                f"({len(tokens)}): the last token is the un-written first "
+                "sample, everything before it has KV",
+            )
+        eng = self.engine
+        # remaining generation budget (one token already emitted)
+        max_len = min(n_ctx + sampling.max_new_tokens, eng.max_seq_len)
+        if len(tokens) >= eng.max_seq_len:
+            return SubmitResult(
+                uid, REJECT_PROMPT_TOO_LONG,
+                f"adopted length {len(tokens)} leaves no room to decode "
+                f"(max_seq_len {eng.max_seq_len})",
+            )
+        if eng.serve_replicas > 1 and max_len > eng.prefill_budget:
+            # same guard as try_submit: a preempted requeue of this request
+            # would re-prefill in ctx chunks the replica-partitioned pool
+            # refuses — reject typed here, not NotImplementedError mid-tick
+            return SubmitResult(
+                uid, REJECT_PROMPT_OVER_BUDGET,
+                f"adopted worst-case length ({max_len}) exceeds the prefill "
+                f"budget ({eng.prefill_budget}) on a serve_replicas="
+                f"{eng.serve_replicas} engine",
+            )
+        blocks = -(-max_len // eng.block_size)
+        pool = eng.mgr.allocator.total_blocks // eng.mgr.replicas
+        if blocks > pool:
+            return SubmitResult(
+                uid, REJECT_POOL_IMPOSSIBLE,
+                f"adopted request needs {blocks} KV blocks at max length; "
+                f"a replica's pool only has {pool}",
+            )
+        triple = (sampling.temperature, sampling.top_k, sampling.top_p)
+        if not self._running and not self.waiting:
+            self._triple = triple
+        elif triple != self._triple:
+            return SubmitResult(
+                uid, REJECT_SAMPLING_CONFLICT,
+                f"sampling triple {triple} conflicts with the scheduled "
+                f"batch's {self._triple}",
+            )
+        if self._shed:
+            self._flt["shed_rejections"].inc()
+            return SubmitResult(
+                uid, RETRY_LATER, "scheduler is shedding load",
+                retry_after_ms=self.retry_after_ms(),
+            )
+        mgr = eng.mgr
+        if not mgr.free_slots:
+            return SubmitResult(uid, RETRY_LATER, "no free sequence slots",
+                                retry_after_ms=self.retry_after_ms())
+        # fresh exclusively-owned pages (match_prefix=False): injection is
+        # about to overwrite them, so cache sharing would stomp live blocks
+        pt, ct = mgr.prompt_tokens_total, mgr.cached_prompt_tokens
+        seq = mgr.admit(uid, tokens, match_prefix=False)
+        fresh = -(-len(tokens) // mgr.block_size)
+        headroom = self._watermark_blocks if self._running else 0
+        ok = fresh + headroom <= mgr._alloc_of(seq).available_blocks
+        if ok:
+            try:
+                mgr.ensure_capacity(seq, 0)
+            except RuntimeError:
+                ok = False
+        # hit-rate accounting restores on EVERY path: the source worker
+        # already counted this prompt at original admission, and the target
+        # never prefills it (KV is injected) — letting the admit's bump
+        # stand would deflate the pool-aggregate prefix_hit_rate with a
+        # phantom full-prompt miss per migration
+        mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
+        if not ok:
+            mgr.release(uid)
+            return SubmitResult(
+                uid, RETRY_LATER,
+                "KV pool cannot hold the migrated sequence under the "
+                "watermark", retry_after_ms=self.retry_after_ms(),
+            )
+        seq.seen_tokens = n_ctx
+        req = ServeRequest(
+            uid=uid, prompt=tokens[:-1], sampling=sampling,
+            tokens=tokens, state=DECODE, generated=[tokens[-1]],
+            submit_tick=self.tick_no, admit_tick=self.tick_no,
+            submit_time=self._clock(), deadline_ms=deadline_ms,
+            ttft_deadline_ms=ttft_deadline_ms,
+            trace=self.telemetry.request_trace(uid, ns=self._eng_ns),
+        )
+        req.trace.submitted(prompt_tokens=len(tokens) - 1)
+        req.trace.admitted()
+        req.trace.tokens(1)
+        self.requests[uid] = req
+        self._running.append(req)
+        self._c["adopted"].inc()
+        self._c["admissions"].inc()
+        return SubmitResult(uid, QUEUED)
+
+    def detach(self, uid: int) -> bool:
+        """Release a request whose ownership moved to ANOTHER worker (KV
+        handoff): typed ``MIGRATED`` terminal state through the single
+        release path — pages free locally (full cached blocks retire to the
+        prefix LRU, warming future affinity hits), tokens stay on the
+        request until popped.  Returns False if unknown/already
+        terminal."""
+        req = self.requests.get(uid)
+        if req is None or req.state in TERMINAL:
+            return False
+        self._release(req, MIGRATED)
         return True
 
     def close(self) -> None:
@@ -834,7 +996,24 @@ class ServeScheduler:
                 self._shed_span.end(tick_end=self.tick_no)
                 self._shed_span = None
 
+    def retry_after_ms(self) -> float:
+        """Backoff hint for ``RETRY_LATER``: shed mode exits once the queue
+        drains to half ``shed_queue_depth``, and roughly one queued request
+        leaves per tick, so the estimate is (queue excess over the exit
+        watermark) x (recent tick duration EMA).  Always >= one tick — a
+        watchdog-triggered shed can hold with an empty queue, and a zero
+        hint would invite the blind-polling this field exists to stop."""
+        depth = self.serve.shed_queue_depth
+        exit_depth = depth // 2 if depth is not None else 0
+        excess = max(1, len(self.waiting) - exit_depth)
+        per_tick = max(self._tick_ms_ema or 1.0, 0.05)
+        return excess * per_tick
+
     def _update_degradation(self, tick_ms: float) -> None:
+        # drain-rate estimate feeding retry_after_ms (EMA so one slow
+        # compile tick does not dominate the hint)
+        self._tick_ms_ema = tick_ms if self._tick_ms_ema is None \
+            else 0.8 * self._tick_ms_ema + 0.2 * tick_ms
         wd = self.serve.watchdog_tick_ms
         if wd is not None:
             if tick_ms > wd:

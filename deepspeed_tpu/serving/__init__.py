@@ -1,0 +1,18 @@
+"""Serve front end: a disaggregated request router over N engine workers.
+
+The layer above ``inference/`` — ``pool.py`` stamps out workers from one
+``ServeEngineConfig`` (per-worker telemetry namespaces, leak-audited
+teardown), ``router.py`` owns the client-facing lifecycle (prefix-affinity
+routing, SLO-aware admission, worker-death replay), and ``handoff.py`` is
+the paged-KV wire for prefill/decode disaggregation (optionally int8 via
+qcomm's payload codec).
+"""
+from .handoff import KVHandoff, extract_request, inject_request  # noqa: F401
+from .pool import (  # noqa: F401
+    MIXED_ROLE,
+    PREFILL_ROLE,
+    Worker,
+    WorkerPool,
+    serve_worker_main,
+)
+from .router import Router, RouterRequest, build_router  # noqa: F401

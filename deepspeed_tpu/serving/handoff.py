@@ -1,0 +1,110 @@
+"""Paged-KV handoff: migrate a prefilled sequence between engine workers.
+
+The prefill/decode disaggregation wire: a long prompt prefills on a
+prefill-role worker (so its multi-hundred-ms forward never stalls a decode
+worker's tick), then at first token the router moves it — this module packs
+the sequence's written KV pages into a host payload
+(:func:`extract_request`), optionally int8/fp8-quantized through qcomm's
+per-chunk-scale codec (the same wire format the quantized collectives use,
+so the budget arithmetic is shared), and scatters it into freshly-owned
+pages on the destination worker (:func:`inject_request`).
+
+Only FULL-block-granular state crosses: the extract covers
+``ceil(seen_tokens / block_size)`` pages (the partial tail page ships whole
+— its rows past ``seen_tokens`` are garbage both sides mask by length), and
+the destination publishes the migrated prefix into its own cache so later
+shared-prefix arrivals hit locally.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..comm import qcomm
+
+
+@dataclass
+class KVHandoff:
+    """One migratable sequence: tokens + its written KV pages on the wire.
+
+    ``payloads`` holds ``(quantized, scales, shape, dtype)`` per pool leaf
+    in ``jax.tree_util`` order over the engine's ``(k_layers, v_layers)``
+    cache tree; ``scales`` is None for the exact ``fmt='none'``
+    passthrough.  ``wire_bytes`` is the payload+scales byte count a
+    cross-process transport would ship (the telemetry figure)."""
+
+    uid: int
+    tokens: List[int]  # prompt + the first sampled token
+    n_ctx: int  # tokens whose KV the payload carries (positions [0, n_ctx))
+    n_pages: int
+    fmt: str
+    payloads: List[Tuple[np.ndarray, Optional[np.ndarray], tuple, np.dtype]]
+    wire_bytes: int
+
+
+def extract_request(engine, uid: int, fmt: str = "none") -> KVHandoff:
+    """Pack ``uid``'s written KV (positions ``[0, seen_tokens)``) from
+    ``engine`` into a :class:`KVHandoff`.  The sequence stays live on the
+    source — extraction is a read, so a failed adoption downstream simply
+    keeps decoding where it was."""
+    import jax
+
+    seq = engine.mgr.seqs[uid]
+    bs = engine.block_size
+    n_ctx = seq.seen_tokens
+    n_pages = -(-n_ctx // bs)
+    if n_pages == 0:
+        raise ValueError(f"uid {uid} has no written KV to extract")
+    blocks = seq.blocks[:n_pages]
+    pages = engine.extract_kv_blocks(blocks)
+    leaves = jax.tree_util.tree_leaves(pages)
+    payloads = []
+    wire = 0
+    for leaf in leaves:
+        q, s = qcomm.quantize_payload(leaf, fmt)
+        payloads.append((q, s, leaf.shape, leaf.dtype))
+        wire += qcomm.payload_wire_bytes(
+            int(np.prod(leaf.shape)), fmt,
+            none_bytes_per_el=leaf.dtype.itemsize,
+        )
+    return KVHandoff(uid=uid, tokens=[int(t) for t in seq.tokens],
+                     n_ctx=n_ctx, n_pages=n_pages, fmt=fmt,
+                     payloads=payloads, wire_bytes=wire)
+
+
+def inject_request(engine, ho: KVHandoff) -> None:
+    """Scatter ``ho``'s pages into ``engine``'s pool for the ALREADY-adopted
+    sequence (``scheduler.adopt_prefilled`` allocated fresh exclusive pages
+    and set ``seen_tokens``), then — for EXACT payloads only — publish the
+    migrated prefix into the destination's prefix cache so affinity keeps
+    paying after the move.  Quantized (int8/fp8) pages stay private to the
+    migrated sequence: the cache's content keys promise exact KV, and
+    serving lossy-roundtrip pages as prefix hits would contaminate
+    requests that never opted into the lossy wire."""
+    import jax
+
+    seq = engine.mgr.seqs[ho.uid]
+    bs = engine.block_size
+    if -(-ho.n_ctx // bs) != ho.n_pages:
+        raise ValueError(
+            f"handoff block size mismatch: payload packed {ho.n_pages} "
+            f"pages for {ho.n_ctx} tokens, destination block_size={bs}")
+    decoded = [
+        qcomm.dequantize_payload(q, s, shape, dtype, ho.fmt)
+        for q, s, shape, dtype in ho.payloads
+    ]
+    treedef = jax.tree_util.tree_structure(engine.kv)
+    engine.inject_kv_blocks(seq.blocks[:ho.n_pages],
+                            jax.tree_util.tree_unflatten(treedef, decoded))
+    if ho.fmt == "none":
+        engine.mgr.update_hashes(seq)
+    else:
+        # placeholder (unkeyed) chain entries for the injected full pages:
+        # the engine's own decode ticks call update_hashes, which would
+        # otherwise publish these lossy pages on the first tick.  With the
+        # head of the chain unkeyed, the allocator's canonical-chain rule
+        # (children of an unkeyed parent never register) keeps every later
+        # block of this sequence unpublished too.
+        seq.hashes = [None] * (ho.n_ctx // bs)

@@ -1,0 +1,228 @@
+"""Engine worker pool: N serve engines stamped out from ONE config.
+
+The bottom half of the serve front end (``serving/router.py`` is the top):
+each :class:`Worker` wraps an ``InferenceEngineV2`` built through the
+canonical ``build_serve_engine`` seam plus its ``ServeScheduler``, and
+exposes exactly the signals the router's placement policy consumes — queue
+depth, running count, pool headroom, shed state, TTFT/TBT percentiles.
+All workers share one ``Telemetry``: the claim-prefix machinery hands each
+engine its own ``serve``/``serve2``/... namespace, so per-worker stats
+never alias and ``engine.close()`` returns the namespace on teardown.
+
+In-process multi-engine is the first deployment shape (the leak-audited
+``engine.close()`` path makes back-to-back and side-by-side engines safe);
+the two-process ``DSTPU_*`` bootstrap (tests/test_multiprocess_bootstrap)
+is the cross-process seam a networked pool grows from —
+:func:`serve_worker_main` is the minimal line-protocol worker loop that
+test drives over a pipe.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..inference.engine_v2 import build_serve_engine
+from ..telemetry import Telemetry
+
+PREFILL_ROLE = "prefill"
+MIXED_ROLE = "mixed"
+
+
+class Worker:
+    """One engine + scheduler pair with the router-facing load surface."""
+
+    def __init__(self, index: int, engine, role: str = MIXED_ROLE):
+        if role not in (PREFILL_ROLE, MIXED_ROLE):
+            raise ValueError(f"unknown worker role {role!r}")
+        self.index = index
+        self.engine = engine
+        self.role = role
+        self.alive = True
+        # router-clock time before which routing skips this worker (set from
+        # a RETRY_LATER rejection's retry_after_ms hint)
+        self.backoff_until = 0.0
+        self.close_audit: Optional[Dict[str, int]] = None
+
+    @property
+    def scheduler(self):
+        return self.engine.scheduler
+
+    @property
+    def ns(self) -> str:
+        """This worker's telemetry namespace (``serve``, ``serve2``, ...)."""
+        return self.engine._ns
+
+    # -- load signals (the router's placement cost) --------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.scheduler.waiting)
+
+    @property
+    def running(self) -> int:
+        return len(self.scheduler._running)
+
+    @property
+    def load(self) -> int:
+        return self.queue_depth + self.running
+
+    @property
+    def headroom_blocks(self) -> int:
+        return self.engine.mgr.allocator.available_blocks
+
+    @property
+    def headroom_fraction(self) -> float:
+        alloc = self.engine.mgr.allocator
+        return alloc.available_blocks / alloc.total_blocks
+
+    @property
+    def shedding(self) -> bool:
+        return self.scheduler.shedding
+
+    def ttft_p50_ms(self) -> float:
+        """Recent TTFT median from this worker's request histograms (0.0
+        while empty/disabled) — the SLO half of the placement cost."""
+        h = self.engine.telemetry.request_hists(self.ns)["ttft"]
+        try:
+            return float(h.percentile(50))
+        except Exception:
+            return 0.0
+
+    # -- lifecycle -----------------------------------------------------------
+    def kill(self) -> None:
+        """Simulated worker death (chaos ``worker_kill``): requests it held
+        are LOST from the router's perspective — the router replays them
+        elsewhere from the prompt.  The engine still tears down through the
+        audited ``close()`` so the process reclaims device memory and the
+        telemetry namespace."""
+        self.alive = False
+        self.close_audit = self.engine.close()
+
+    def close(self) -> Dict[str, int]:
+        """Graceful teardown via the leak-audited ``engine.close()``;
+        idempotent, returns the zero-leak audit."""
+        self.alive = False
+        self.close_audit = self.engine.close()
+        return self.close_audit
+
+
+class WorkerPool:
+    """``n_workers`` engines from one ``ServeEngineConfig``, first
+    ``prefill_workers`` of them in the PREFILL role (long-prompt targets for
+    prefill/decode disaggregation)."""
+
+    def __init__(self, params, cfg, sec, n_workers: int = 2,
+                 prefill_workers: int = 0, telemetry=None, serve=None,
+                 faults=None, devices_per_worker=None):
+        if n_workers < 1:
+            raise ValueError(f"need at least one worker, got {n_workers}")
+        if not 0 <= prefill_workers < n_workers:
+            raise ValueError(
+                f"prefill_workers ({prefill_workers}) must leave at least "
+                f"one decode-capable worker of {n_workers}")
+        self.telemetry = Telemetry.ensure(telemetry)
+        self.workers: List[Worker] = []
+        for i in range(n_workers):
+            devs = devices_per_worker[i] if devices_per_worker else None
+            eng = build_serve_engine(
+                params, cfg, sec, telemetry=self.telemetry, serve=serve,
+                faults=faults, devices=devs,
+            )
+            role = PREFILL_ROLE if i < prefill_workers else MIXED_ROLE
+            self.workers.append(Worker(i, eng, role))
+
+    def __len__(self) -> int:
+        return len(self.workers)
+
+    @property
+    def alive(self) -> List[Worker]:
+        return [w for w in self.workers if w.alive]
+
+    @property
+    def decode_workers(self) -> List[Worker]:
+        return [w for w in self.alive if w.role == MIXED_ROLE]
+
+    @property
+    def prefill_workers(self) -> List[Worker]:
+        return [w for w in self.alive if w.role == PREFILL_ROLE]
+
+    def prefix_hit_rate(self) -> float:
+        """Aggregate prompt prefix-cache hit rate across all workers (the
+        front end's headline: replica scale WITHOUT forfeiting the shared-
+        prefix wins the 2-D mesh gates off)."""
+        total = sum(w.engine.mgr.prompt_tokens_total for w in self.workers)
+        cached = sum(w.engine.mgr.cached_prompt_tokens for w in self.workers)
+        return cached / total if total else 0.0
+
+    def close(self) -> List[Dict[str, int]]:
+        """Tear every worker down through ``engine.close()`` (idempotent;
+        killed workers report their audit from death time).  Returns the
+        per-worker zero-leak audits."""
+        return [w.close() if w.alive else (w.close_audit or w.close())
+                for w in self.workers]
+
+
+def serve_worker_main(stdin=None, stdout=None, params=None, cfg=None,
+                      sec=None, serve=None) -> None:
+    """Minimal cross-process worker loop: one JSON request per line on
+    ``stdin`` -> one JSON reply per line on ``stdout``.  The process-level
+    seam the two-process router smoke drives — the engine bootstraps through
+    ``comm.init_distributed`` (the ``DSTPU_*`` env protocol) exactly like a
+    launcher-spawned serve process, then serves ``submit`` requests through
+    the same scheduler path the in-process pool uses.
+
+    Protocol (newline-delimited JSON):
+      ``{"op": "submit", "uid": int, "tokens": [...], "max_new_tokens": n}``
+        -> ``{"uid": ..., "state": ..., "tokens": [...]}``
+      ``{"op": "stats"}`` -> the worker's serve/sched stats dicts
+      ``{"op": "close"}`` -> ``{"audit": {...}}`` and the loop exits
+    """
+    import json
+    import sys
+
+    from ..comm.comm import init_distributed
+    from ..inference.sampling import SamplingParams
+
+    init_distributed()  # DSTPU_* env (single process: a no-op bootstrap)
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    engine = build_serve_engine(params, cfg, sec, serve=serve)
+    sched = engine.scheduler
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        op = msg.get("op")
+        if op == "close":
+            audit = engine.close()
+            print(json.dumps({"audit": audit}), file=stdout, flush=True)
+            break
+        if op == "stats":
+            print(json.dumps({"serve": dict(engine.stats),
+                              "sched": dict(sched.stats)}),
+                  file=stdout, flush=True)
+            continue
+        if op == "submit":
+            uid = int(msg["uid"])
+            samp = SamplingParams(
+                temperature=float(msg.get("temperature", 0.0)),
+                max_new_tokens=int(msg.get("max_new_tokens", 16)),
+            )
+            res = sched.try_submit(uid, msg["tokens"], samp)
+            if not res.accepted:
+                print(json.dumps({"uid": uid, "state": "rejected",
+                                  "reason": res.reason}),
+                      file=stdout, flush=True)
+                continue
+            sched.run(wait_for=[uid])
+            state = sched.requests[uid].state
+            toks = sched.pop_result(uid)
+            print(json.dumps({"uid": uid, "state": state, "tokens": toks}),
+                  file=stdout, flush=True)
+            continue
+        print(json.dumps({"error": f"unknown op {op!r}"}),
+              file=stdout, flush=True)
+
+
+__all__: List[Any] = [
+    "MIXED_ROLE", "PREFILL_ROLE", "Worker", "WorkerPool", "serve_worker_main",
+]

@@ -43,6 +43,94 @@ print(f"OK proc={pid}")
 """
 
 
+_ROUTER_WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# the DSTPU_* bootstrap must precede ANY jax computation (init_params
+# below); serve_worker_main's own init_distributed call is then a no-op
+from deepspeed_tpu.comm.comm import init_distributed
+init_distributed()
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import get_preset
+from deepspeed_tpu.models.transformer import init_params
+from deepspeed_tpu.serving import serve_worker_main
+
+cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
+params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+serve_worker_main(
+    params=params, cfg=cfg,
+    sec=dict(max_seqs=2, num_blocks=32, block_size=8,
+             prefill_buckets=[16, 32]),
+)
+"""
+
+
+@pytest.mark.nightly  # spawns a fresh jax worker process (~30 s)
+def test_two_process_router_worker_round_trip():
+    """Router process + worker process over the ``DSTPU_*`` env protocol:
+    the worker bootstraps through ``comm.init_distributed`` (the same env
+    seam the launcher/runners emit — a real ``jax.distributed.initialize``
+    with a live coordinator), serves the ``serve_worker_main`` line
+    protocol, and one request round-trips token-identically to an in-proc
+    reference engine.  This test's own process plays the router side of the
+    pipe — the cross-process seam the in-proc ``serving.WorkerPool`` grows
+    from."""
+    import json
+
+    from deepspeed_tpu.inference.engine_v2 import build_serve_engine
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+
+    import jax
+    import jax.numpy as jnp
+
+    port = 9231 + (os.getpid() % 500)
+    env = dict(os.environ)
+    env.update({
+        "DSTPU_COORDINATOR": f"127.0.0.1:{port}",
+        "DSTPU_NUM_PROCESSES": "1",
+        "DSTPU_PROCESS_ID": "0",
+        "JAX_PLATFORMS": "",
+    })
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _ROUTER_WORKER], env=env,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        req = {"op": "submit", "uid": 1, "tokens": prompt,
+               "max_new_tokens": 6, "temperature": 0.0}
+        proc.stdin.write(json.dumps(req) + "\n")
+        proc.stdin.write(json.dumps({"op": "close"}) + "\n")
+        proc.stdin.flush()
+        out, err = proc.communicate(timeout=240)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, f"worker failed:\n{out}\n{err[-2000:]}"
+    lines = [json.loads(l) for l in out.splitlines() if l.strip()]
+    reply = lines[0]
+    assert reply["state"] == "finished", reply
+    # zero-leak audit from the worker's engine.close()
+    assert lines[1]["audit"]["blocks_in_use"] == 0, lines[1]
+
+    # greedy token identity vs an in-proc reference engine (same seed 0
+    # fp32 init on the same platform -> bit-identical params)
+    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    ref = build_serve_engine(params, cfg, dict(
+        max_seqs=2, num_blocks=32, block_size=8, prefill_buckets=[16, 32]))
+    want = ref.generate(prompt, SamplingParams(temperature=0.0,
+                                               max_new_tokens=6))
+    ref.close()
+    assert reply["tokens"] == want, (reply["tokens"], want)
+
+
 @pytest.mark.nightly  # spawns two fresh jax processes (~30 s)
 def test_two_process_bootstrap_and_collective(tmp_path):
     port = 9731 + (os.getpid() % 500)

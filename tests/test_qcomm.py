@@ -563,3 +563,40 @@ def test_moe_qcomm_config_routes_through_ep(monkeypatch):
     # global GSPMD aux (mean of products != product of means), so the
     # total loss agrees to ~1e-3, not bitwise
     np.testing.assert_allclose(float(got), float(ref), rtol=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# host-side payload codec (the paged-KV handoff wire format)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("fmt", ["none", "int8", "fp8"])
+def test_payload_codec_round_trip(fmt):
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((3, 8, 2, 4)).astype(np.float32)
+    q, s = qcomm.quantize_payload(arr, fmt)
+    out = qcomm.dequantize_payload(q, s, arr.shape, np.float32, fmt)
+    assert out.shape == arr.shape and out.dtype == np.float32
+    if fmt == "none":
+        assert s is None
+        np.testing.assert_array_equal(out, arr)  # exact passthrough
+    else:
+        # per-chunk amax scaling bounds the relative error like the
+        # collectives' wire format (int8: ~amax/127 per element)
+        err = np.abs(out - arr).max()
+        amax = np.abs(arr).max()
+        bound = amax / 127 if fmt == "int8" else amax / 8
+        assert err <= bound * 1.01, (err, bound)
+
+
+def test_payload_codec_rejects_bad_fmt():
+    with pytest.raises(qcomm.QCommError):
+        qcomm.quantize_payload(np.zeros(4, np.float32), "int4")
+    with pytest.raises(qcomm.QCommError):
+        qcomm.payload_wire_bytes(16, "bf16")
+
+
+def test_payload_wire_bytes_accounting():
+    # 1000 elements, chunk 256 -> 4 scale groups
+    assert qcomm.payload_wire_bytes(1000, "none") == 2000  # bf16 default
+    assert qcomm.payload_wire_bytes(1000, "none", none_bytes_per_el=4) == 4000
+    assert qcomm.payload_wire_bytes(1000, "int8") == 1000 + 4 * 4
+    assert qcomm.payload_wire_bytes(1000, "fp8") == 1000 + 4 * 4

@@ -1,0 +1,457 @@
+"""Serve front end (deepspeed_tpu/serving/): seeded router storm over >= 2
+workers (affinity hit-rate >= least-loaded baseline, zero allocator leaks
+after drain, greedy token-identity vs a single-engine reference),
+prefill/decode disaggregation via the paged-KV handoff (exact and int8
+wire), worker-kill re-route + replay, SLO backpressure (retry_after_ms
+hints, front-door shed), and the dp>1 over-budget typed reject."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import ConfigError, RouterConfig
+from deepspeed_tpu.inference import scheduler as sched_mod
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2, build_serve_engine
+from deepspeed_tpu.inference.faults import FaultInjector
+from deepspeed_tpu.inference.sampling import SamplingParams
+from deepspeed_tpu.models import get_preset
+from deepspeed_tpu.models.transformer import init_params
+from deepspeed_tpu.serving import build_router
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    # fp32 so greedy token identity cannot flip on bf16 near-ties
+    cfg = get_preset("tiny", max_seq_len=256, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+SEC = dict(max_seqs=4, num_blocks=96, block_size=8,
+           prefill_buckets=[16, 32, 64, 128], max_seq_len=256,
+           enable_prefix_caching=True)
+
+
+def _workload(cfg, n_req=16, seed=0):
+    """Mixed traffic: odd uids share a system prompt (affinity population),
+    even uids are cold unique prompts (balance population)."""
+    rng = np.random.default_rng(seed)
+    sys_prompt = rng.integers(1, cfg.vocab_size, 24).tolist()
+    out = {}
+    for u in range(1, n_req + 1):
+        sfx = rng.integers(1, cfg.vocab_size, 8).tolist()
+        out[u] = (sys_prompt + sfx if u % 2 else
+                  rng.integers(1, cfg.vocab_size, 24).tolist() + sfx)
+    return out
+
+
+def _reference(tiny, prompts, samp):
+    cfg, params = tiny
+    eng = build_serve_engine(params, cfg, SEC)
+    sched = eng.scheduler
+    for u, p in prompts.items():
+        assert sched.try_submit(u, p, samp).accepted
+    sched.run()
+    want = {u: sched.pop_result(u) for u in prompts}
+    eng.close()
+    return want
+
+
+# ---------------------------------------------------------------------------
+# the seeded storm: affinity vs least-loaded, leaks, token identity
+# ---------------------------------------------------------------------------
+def test_router_storm_affinity_beats_least_loaded(tiny):
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    prompts = _workload(cfg)
+    want = _reference(tiny, prompts, samp)
+
+    hit_rates = {}
+    for affinity in (True, False):
+        router = build_router(params, cfg, SEC,
+                              router=dict(n_workers=2, affinity=affinity))
+        # arrival-interleaved submission so placement happens under load
+        uids = list(prompts)
+        for i in range(0, len(uids), 4):
+            for u in uids[i:i + 4]:
+                assert router.try_submit(u, prompts[u], samp).accepted
+            router.tick()
+        out = router.run()
+        assert all(out[u] == ("finished", want[u]) for u in prompts), (
+            "routed tokens diverged from the single-engine reference")
+        hit_rates[affinity] = router.prefix_hit_rate()
+        stats = dict(router.stats)
+        if affinity:
+            assert stats["routed_affinity"] > 0
+        else:
+            assert stats["routed_affinity"] == 0
+        # both workers actually served traffic
+        assert all(w.engine.mgr.prompt_tokens_total > 0
+                   for w in router.pool.workers)
+        # zero-leak drain on EVERY worker
+        for audit in router.close():
+            assert audit["blocks_in_use"] == 0, audit
+    assert hit_rates[True] > 0.0
+    assert hit_rates[True] >= hit_rates[False], hit_rates
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation: the paged-KV handoff
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("fmt", ["none", "int8"])
+def test_kv_handoff_token_identity(tiny, fmt):
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    rng = np.random.default_rng(3)
+    long_prompt = rng.integers(1, cfg.vocab_size, 48).tolist()
+    short = rng.integers(1, cfg.vocab_size, 8).tolist()
+
+    ref = build_serve_engine(params, cfg, SEC)
+    want_long = ref.generate(long_prompt, samp)
+    want_short = ref.generate(short, samp)
+    ref.close()
+
+    router = build_router(
+        params, cfg, SEC,
+        router=dict(n_workers=3, prefill_workers=1, disagg_threshold=32,
+                    handoff_fmt=fmt),
+    )
+    router.submit(1, long_prompt, samp)
+    router.submit(2, short, samp)
+    out = router.run()
+    stats = dict(router.stats)
+    # the long prompt went prefill-worker -> migrated at first token
+    assert stats["routed_prefill"] == 1
+    assert stats["handoffs"] == 1
+    assert stats["handoff_wire_bytes"] > 0
+    # exact wire accounting: ceil(48/8)=6 pages x bs x hkv x hd, K and V,
+    # every layer; fp32 pages ship 4 B/el exact, int8 ~1 B/el + scales
+    els = 2 * cfg.num_layers * 6 * 8 * cfg.num_kv_heads * cfg.hd
+    if fmt == "none":
+        assert stats["handoff_wire_bytes"] == els * 4
+    else:
+        assert els <= stats["handoff_wire_bytes"] < 1.5 * els
+    # migration bookkeeping: MIGRATED on the source, adopted on the target
+    src = router.pool.workers[0]
+    assert dict(src.scheduler.stats)["migrated"] == 1
+    assert sum(dict(w.scheduler.stats)["adopted"]
+               for w in router.pool.workers[1:]) == 1
+    # greedy token identity through the handoff, both wire formats
+    assert out[1] == ("finished", want_long)
+    assert out[2] == ("finished", want_short)
+    for audit in router.close():
+        assert audit["blocks_in_use"] == 0, audit
+
+
+def test_handoff_publishes_prefix_on_target(tiny):
+    """After a migration the destination's cache holds the migrated prefix:
+    a follow-up prompt sharing it prefix-hits locally."""
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    rng = np.random.default_rng(4)
+    long_prompt = rng.integers(1, cfg.vocab_size, 48).tolist()
+    router = build_router(
+        params, cfg, SEC,
+        router=dict(n_workers=2, prefill_workers=1, disagg_threshold=32))
+    router.submit(1, long_prompt, samp)
+    router.run(wait_for=[1])
+    assert dict(router.stats)["handoffs"] == 1
+    tgt = router.pool.workers[1]
+    before = tgt.engine.mgr.cached_prompt_tokens
+    # short follow-up (below the disagg threshold) sharing the migrated
+    # prefix: affinity routes it to the DECODE worker, where the injected
+    # pages were published — it must hit there
+    router.submit(2, long_prompt[:24], samp)
+    router.run(wait_for=[2])
+    assert dict(router.stats)["routed_affinity"] == 1
+    assert tgt.engine.mgr.cached_prompt_tokens > before
+    for audit in router.close():
+        assert audit["blocks_in_use"] == 0, audit
+
+
+def test_quantized_handoff_pages_stay_out_of_prefix_cache(tiny):
+    """int8 handoff pages are lossy roundtrips — they must NOT publish into
+    the destination's exact-match prefix cache (a follow-up prefix hit
+    would silently decode against off-by-quantization KV)."""
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    rng = np.random.default_rng(6)
+    long_prompt = rng.integers(1, cfg.vocab_size, 48).tolist()
+    router = build_router(
+        params, cfg, SEC,
+        router=dict(n_workers=2, prefill_workers=1, disagg_threshold=32,
+                    handoff_fmt="int8"))
+    router.submit(1, long_prompt, samp)
+    router.run(wait_for=[1])
+    assert dict(router.stats)["handoffs"] == 1
+    tgt = router.pool.workers[1]
+    # the migrated sequence's injected pages carry NO published keys
+    assert tgt.engine.mgr.allocator.registrations == 0
+    # ... and the lossy migration must not re-point the affinity chain at
+    # the target either (it holds nothing hittable): a follow-up sharing
+    # the prefix places least-loaded and never hits quantized pages
+    router.submit(2, long_prompt[:24], samp)
+    router.run(wait_for=[2])
+    assert dict(router.stats)["routed_affinity"] == 0
+    assert tgt.engine.mgr.cached_prompt_tokens == 0
+    for audit in router.close():
+        assert audit["blocks_in_use"] == 0, audit
+
+
+def test_handoff_jits_compile_bounded_shapes(tiny):
+    """extract/inject pad page counts to powers of two: migrating prompts of
+    many distinct lengths must not compile a fresh program per length — the
+    scatter donates the whole pool, so each novel shape would stall every
+    worker's tick mid-migration."""
+    cfg, params = tiny
+    eng = build_serve_engine(params, cfg, SEC)
+    try:
+        for n in (1, 2, 3, 4, 5, 6, 7):
+            blocks = list(range(n))
+            pages = eng.extract_kv_blocks(blocks)
+            for leaf in jax.tree_util.tree_leaves(pages):
+                assert leaf.shape[0] == n  # padding never leaks to callers
+            eng.inject_kv_blocks(blocks, pages)
+        # page counts 1..7 collapse into pad buckets {1, 2, 4, 8}
+        assert eng._kv_gather_jit._cache_size() <= 4
+        assert eng._kv_scatter_jit._cache_size() <= 4
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# worker death: re-route + replay from the prompt
+# ---------------------------------------------------------------------------
+def test_worker_kill_reroutes_and_replays(tiny):
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompts = _workload(cfg, n_req=8, seed=5)
+    want = _reference(tiny, prompts, samp)
+
+    inj = FaultInjector(seed=0).arm("worker_kill", uids=[0], after=3, times=1)
+    router = build_router(params, cfg, SEC, router=dict(n_workers=2),
+                          faults=inj)
+    for u, p in prompts.items():
+        assert router.try_submit(u, p, samp).accepted
+    out = router.run()
+    stats = dict(router.stats)
+    assert stats["worker_deaths"] == 1
+    assert stats["replays"] > 0
+    assert not router.pool.workers[0].alive
+    # every request — including the replayed ones — finishes with the exact
+    # fault-free greedy tokens
+    assert all(out[u] == ("finished", want[u]) for u in prompts)
+    # dead worker audited clean at kill time; survivor drains clean
+    for audit in router.close():
+        assert audit["blocks_in_use"] == 0, audit
+
+
+def test_replay_budget_exhaustion_fails_typed(tiny):
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    # both workers die; max_replays=0 -> the lost request fails typed
+    inj = (FaultInjector(seed=0)
+           .arm("worker_kill", uids=[0], after=1, times=1)
+           .arm("worker_kill", uids=[1], after=1, times=1))
+    router = build_router(params, cfg, SEC,
+                          router=dict(n_workers=2, max_replays=0),
+                          faults=inj)
+    res = router.try_submit(1, [3, 1, 4, 1, 5], samp)
+    assert res.accepted
+    for _ in range(4):
+        router.tick()
+    state, toks = router.pop_result(1)
+    assert state == "failed" and toks == []
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO backpressure: retry_after_ms + front-door shed
+# ---------------------------------------------------------------------------
+def test_retry_later_carries_retry_after_hint(tiny):
+    cfg, params = tiny
+    eng = InferenceEngineV2(
+        params, cfg, serve=dict(shed_queue_depth=2),
+        **{k: v for k, v in SEC.items()})
+    sched = eng.scheduler
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    for uid in range(1, 9):
+        sched.try_submit(uid, [7] * 40, samp)
+    sched.tick()  # queue depth over the shed threshold -> shed mode
+    assert sched.shedding
+    res = sched.try_submit(99, [7] * 8, samp)
+    assert res.reason == sched_mod.RETRY_LATER
+    assert res.retry_after_ms is not None and res.retry_after_ms > 0
+    # deeper backlog -> larger hint (proportional, not blind-poll constant)
+    shallow = sched.retry_after_ms()
+    extra = list(sched.waiting)
+    sched.waiting.extend(extra)  # artificially double the queue
+    assert sched.retry_after_ms() > shallow
+    for _ in extra:
+        sched.waiting.pop()
+    eng.close()
+
+
+def test_router_front_door_shed(tiny):
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    # engine sheds instantly (depth 1), router backlog capped at 2
+    router = build_router(params, cfg, SEC,
+                          router=dict(n_workers=1, shed_queue_depth=2),
+                          serve=dict(shed_queue_depth=1))
+    # burst-fill the worker queue, then one tick flips its shed detector
+    for uid in range(1, 7):
+        assert router.try_submit(uid, [5] * 40, samp).accepted
+    router.tick()
+    assert router.pool.workers[0].shedding
+    # shedding worker rejects -> the router absorbs into its backlog until
+    # the front-door depth (2) is hit, then the CLIENT gets the typed shed
+    shed = None
+    for uid in range(7, 12):
+        res = router.try_submit(uid, [5] * 40, samp)
+        if not res.accepted:
+            shed = res
+            break
+    assert shed is not None, "router never shed at the front door"
+    assert shed.reason == sched_mod.RETRY_LATER
+    assert shed.retry_after_ms is not None and shed.retry_after_ms > 0
+    assert dict(router.stats)["shed_rejections"] >= 1
+    router.run()  # the admitted backlog still drains to terminal states
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# dp>1 over-budget close-out (the PR 7 documented gap)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def dp2_engine(tiny):
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+
+    cfg, params = tiny
+    grid = initialize_mesh(devices=jax.devices()[:2], batch=2, model=1)
+    eng = InferenceEngineV2(
+        params, cfg, grid=grid, serve_replicas=2, max_seqs=4, num_blocks=64,
+        block_size=8, prefill_buckets=(16, 32), prefill_budget=32,
+        max_seq_len=256)
+    yield eng
+    eng.close()
+
+
+def test_dp2_over_budget_prompt_rejected_typed(dp2_engine):
+    sched = dp2_engine.scheduler
+    # 30 prompt + 8 new = 38 > budget 32: typed reject, not a silent
+    # cross-replica ctx gather
+    res = sched.try_submit(1, [3] * 30,
+                           SamplingParams(temperature=0.0, max_new_tokens=8))
+    assert res.reason == sched_mod.REJECT_PROMPT_OVER_BUDGET
+    assert res.reason in sched_mod.CLIENT_ERRORS
+    # within budget still queues
+    res = sched.try_submit(2, [3] * 20,
+                           SamplingParams(temperature=0.0, max_new_tokens=8))
+    assert res.accepted
+
+
+def test_dp2_ctx_pack_refused_loudly(dp2_engine):
+    """The engine-level belt-and-braces: a continuation (start > 0) pack on
+    a replica-partitioned pool raises instead of silently gathering."""
+    eng = dp2_engine
+    seq = eng.mgr.admit(7, [3] * 24)
+    eng.mgr.ensure_capacity(seq, 0)
+    seq.seen_tokens = 8  # pretend the first page prefilled in a prior chunk
+    with pytest.raises(NotImplementedError, match="replica-local"):
+        eng.prefill_entries([(seq, 8, 24)],
+                            SamplingParams(temperature=0.0))
+    eng.mgr.release(7)
+
+
+# ---------------------------------------------------------------------------
+# adoption-path validation (the scheduler half of the handoff)
+# ---------------------------------------------------------------------------
+def test_adopt_prefilled_validation(tiny):
+    cfg, params = tiny
+    eng = build_serve_engine(params, cfg, SEC)
+    sched = eng.scheduler
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    pt, ct = eng.mgr.prompt_tokens_total, eng.mgr.cached_prompt_tokens
+    ok = sched.adopt_prefilled(1, [5] * 17, n_ctx=16, sampling=samp)
+    assert ok.accepted
+    # adoption must not move the prefix-hit-rate accounting: the source
+    # worker already counted this prompt, and the target never prefills it
+    assert (eng.mgr.prompt_tokens_total, eng.mgr.cached_prompt_tokens) \
+        == (pt, ct)
+    seq = eng.mgr.seqs[1]
+    assert seq.seen_tokens == 16 and len(seq.blocks) == 3  # ceil(17/8)
+    assert sched.requests[1].state == sched_mod.DECODE
+    assert sched.requests[1].generated == [5]
+    # duplicate uid + bad n_ctx are typed client errors
+    assert sched.adopt_prefilled(1, [5] * 17, 16, samp).reason \
+        == sched_mod.REJECT_DUPLICATE_UID
+    assert sched.adopt_prefilled(2, [5] * 17, 17, samp).reason \
+        == sched_mod.REJECT_EMPTY_PROMPT
+    # the adopted request decodes to completion through the normal loop
+    sched.run(wait_for=[1])
+    assert sched.requests[1].state == sched_mod.FINISHED
+    sched.pop_result(1)
+    audit = eng.close()
+    assert audit["blocks_in_use"] == 0
+
+
+def test_sampling_conflict_reroutes_not_rejects(tiny):
+    """A sampling-triple conflict is per-worker BATCH state: the router
+    must try the next candidate (or backlog), never hard-reject the
+    client."""
+    cfg, params = tiny
+    router = build_router(params, cfg, SEC, router=dict(n_workers=2))
+    warm = SamplingParams(temperature=0.7, top_k=5, max_new_tokens=16)
+    greedy = SamplingParams(temperature=0.0, max_new_tokens=4)
+    shared = [9] * 24
+    # occupy worker picked for `shared` with a sampled batch (affinity
+    # notes that worker for the shared prefix)
+    assert router.try_submit(1, shared + [1, 2], warm).accepted
+    router.tick()
+    # greedy request with the same prefix affinity-routes to the busy
+    # worker, conflicts there, and must land on the OTHER worker (or queue)
+    res = router.try_submit(2, shared + [3, 4], greedy)
+    assert res.accepted, res
+    out = router.run()
+    assert out[1][0] == "finished" and out[2][0] == "finished"
+    assert dict(router.stats)["rejected"] == 0
+    router.close()
+
+
+def test_router_config_validation():
+    with pytest.raises(ConfigError):
+        RouterConfig(n_workers=0)
+    with pytest.raises(ConfigError):
+        RouterConfig(n_workers=2, prefill_workers=2)  # no decode worker left
+    with pytest.raises(ConfigError):
+        RouterConfig(handoff_fmt="int4")
+    RouterConfig(n_workers=3, prefill_workers=1, handoff_fmt="int8")
+
+
+# ---------------------------------------------------------------------------
+# CI fast lane: the bench --serving --router --smoke path, in-proc
+# ---------------------------------------------------------------------------
+def test_bench_serving_router_smoke(capsys):
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.router_serve_main(smoke=True)
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    payload = json.loads(line)
+    assert payload["metric"] == "serve_router_prefix_hit_rate"
+    assert payload["value"] > 0.0
+    extra = payload["extra"]
+    assert extra["replicated_gated_hit_rate"] == 0.0
+    assert extra["routed_token_identical"] is True
+    assert extra["kv_handoff"]["none"]["token_identical"] is True
+    assert extra["kv_handoff"]["int8"]["token_identical"] is True
+    assert extra["kv_handoff"]["int8_wire_saving"] > 0.5
+    assert extra["allocator_leak_check"] == "pass"
+    assert len(set(extra["worker_namespaces"])) == 2
