@@ -1781,6 +1781,14 @@ def audit_main(smoke: bool = False, out: str = None):
     - **astlint** — the three source-lint passes over ``deepspeed_tpu/``
       (host syncs in tick/step hot paths, new process-global mutable
       state, raw lax collectives outside comm/);
+    - **racelint** — the lock-discipline passes over the host-side serving
+      stack (unguarded shared-state writes, lock-order cycles, blocking
+      calls under a lock, cross-thread engine access), gated on the
+      shrink-only ``RACE_BASELINE`` (growth AND staleness both fail);
+    - **schedviz** — the seeded deterministic-interleaving harness sweeps
+      the hot concurrent scenarios (namespace claim vs snapshot,
+      submit/tick/cancel, shed vs watchdog, worker-kill vs route) over a
+      bank of schedules; any failing seed replays exactly;
     - **serve** — compiled-program audit of every serving hot jit (decode,
       packed prefill, ctx-pack prefill, speculative verify) on a tp=2
       engine in BOTH transports (passthrough and int8 + tiles): donation
@@ -1807,6 +1815,10 @@ def audit_main(smoke: bool = False, out: str = None):
         audit_serve_engine,
         audit_train_step,
         lint_package,
+        lint_race_package,
+        run_scenarios,
+        stale_race_baseline,
+        unbaselined,
     )
     from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.models import CausalLM, get_preset
@@ -1816,6 +1828,20 @@ def audit_main(smoke: bool = False, out: str = None):
     lint = lint_package()
     report["astlint"] = {"passed": not lint,
                          "violations": [str(v) for v in lint]}
+
+    # Graft Race: static lock-discipline lint (shrink-only baseline — both
+    # un-baselined violations AND stale baseline entries fail) plus the
+    # seeded interleaving harness over the hot concurrent scenarios
+    race = lint_race_package()
+    race_fresh = unbaselined(race)
+    race_stale = stale_race_baseline(race)
+    report["racelint"] = {
+        "passed": not race_fresh and not race_stale,
+        "violations": [str(v) for v in race_fresh],
+        "baselined": len(race) - len(race_fresh),
+        "stale_baseline": ["/".join(k) for k in race_stale],
+    }
+    report["schedviz"] = run_scenarios(seeds=range(4 if smoke else 16))
 
     n_dev = len(jax.devices())
     tp = 2 if n_dev >= 2 else 1
@@ -1876,7 +1902,10 @@ def audit_main(smoke: bool = False, out: str = None):
             return sum(_count(v) for v in node)
         return 0
 
-    n_viol = len(lint) + _count(report["serve"]) + _count(report["train"])
+    n_race = len(race_fresh) + len(race_stale) + sum(
+        len(r["failures"]) for r in report["schedviz"]["scenarios"].values())
+    n_viol = (len(lint) + n_race + _count(report["serve"])
+              + _count(report["train"]))
     out = out or "audit_report.json"
     with open(out, "w") as fh:
         json.dump(report, fh, indent=1, sort_keys=True)
@@ -1887,6 +1916,9 @@ def audit_main(smoke: bool = False, out: str = None):
         "vs_baseline": None,
         "extra": {
             "astlint_passed": report["astlint"]["passed"],
+            "racelint_passed": report["racelint"]["passed"],
+            "schedviz_passed": report["schedviz"]["passed"],
+            "schedviz_schedules": report["schedviz"]["schedules_total"],
             "serve_passed": {k: v["passed"]
                              for k, v in report["serve"].items()},
             "serve_jits_audited": sorted(

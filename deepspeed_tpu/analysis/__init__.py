@@ -15,10 +15,31 @@ Two halves (README "Static analysis & program audit"):
   forbidding host syncs in the tick/step hot paths, new process-global
   mutable state, and raw ``lax`` collectives outside ``comm/``.
 
-Entry points: ``bench.py --audit`` (JSON report) and the pytest gate in
-``tests/test_analysis.py`` (tier-1 fast lane).
+Graft Race (README "Concurrency model & race analysis") extends the same
+prove-don't-regex stance to the HOST-side concurrency seam:
+
+- **Lock-discipline lint** (:mod:`racelint`): infers which locks guard
+  which attributes from the code's own ``with self._lock:`` patterns, then
+  flags unguarded shared-state writes, lock-order cycles, blocking calls
+  under a lock, and engine/jit access from non-owner threads.
+- **Deterministic interleaving harness** (:mod:`schedviz`): a seeded
+  cooperative scheduler (CHESS-style bounded preemption) that replays the
+  hot concurrent serving scenarios — namespace claim vs snapshot,
+  submit/tick/cancel, shed vs watchdog, worker-kill vs route — as pure
+  functions of their seed.
+
+Entry points: ``bench.py --audit`` (JSON report) and the pytest gates in
+``tests/test_analysis.py`` / ``tests/test_racelint.py`` (tier-1 fast lane).
 """
 from .astlint import LintViolation, lint_package, lint_source
+from .racelint import (
+    RaceViolation,
+    lint_race_package,
+    lint_race_source,
+    stale_race_baseline,
+    unbaselined,
+)
+from .schedviz import Schedule, checkpoint, explore, run_scenarios
 from .audit import audit_serve_engine, audit_train_step, serve_jit_specs
 from .checks import (
     CheckResult,
@@ -57,9 +78,18 @@ __all__ = [
     "check_overlap",
     "check_payload_dtypes",
     "check_tp_param_sharding",
+    "RaceViolation",
+    "Schedule",
+    "checkpoint",
+    "explore",
     "lint_package",
+    "lint_race_package",
+    "lint_race_source",
     "lint_source",
     "parse_scheduled_hlo",
     "program_facts",
+    "run_scenarios",
+    "stale_race_baseline",
     "stablehlo_collectives",
+    "unbaselined",
 ]

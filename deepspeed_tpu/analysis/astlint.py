@@ -43,9 +43,20 @@ HOT_PATHS: Dict[str, Set[str]] = {
         "_run_packed_prefill", "prefill_entries", "_decode_tick",
         "_spec_tick", "step", "step_n", "_tables_device",
         "_sampling_device", "_account_comm", "_set_block_table",
+        # the KV-handoff seam (PR 12): np.asarray is the designed host
+        # copy; any OTHER sync primitive mid-migration stalls the tick
+        "extract_kv_blocks", "inject_kv_blocks",
     },
-    # the serve loop's per-tick driver
-    "inference/scheduler.py": {"tick"},
+    # the serve loop's per-tick driver, plus the whole intake surface: it
+    # now runs under the scheduler's intake lock (PR 13), so a host sync
+    # there stalls every submitter AND the tick phases behind the lock —
+    # the blocking-under-lock class racelint flags, caught at the source
+    "inference/scheduler.py": {
+        "tick", "try_submit", "_try_submit_locked", "adopt_prefilled",
+        "_adopt_prefilled_locked", "cancel", "detach", "_release",
+        "_release_locked", "_admit_phase", "_try_admit_locked",
+        "_expire_phase", "_preempt", "retry_after_ms", "pop_result",
+    },
     # the router front end's control loop + its load-signal reads: router
     # instrumentation must never add a device round trip to a worker's tick
     # (each engine already owns its one designed np.asarray fetch), and the

@@ -291,9 +291,13 @@ class InferenceEngineV2:
         # stats view never aliases the first engine's counters.  The sched
         # namespace is claimed HERE, not at first scheduler access — lazy
         # claiming would pair serve2/ with sched/ if engine 2's scheduler
-        # happened to be touched first
-        self._ns = self.telemetry.claim_prefix("serve")
-        self._sched_ns = self.telemetry.claim_prefix("sched")
+        # happened to be touched first.  All three namespaces are claimed
+        # as ONE atomic group (shared suffix) — sequential claim_prefix
+        # calls let two engines constructed concurrently on a shared
+        # Telemetry interleave into serve2/sched3 (the mispairing
+        # schedviz's namespace scenario replays deterministically)
+        self._ns, self._sched_ns, self._comm_ns = \
+            self.telemetry.claim_prefixes(("serve", "sched", "comm"))
         self._c = self.telemetry.counters(self._ns, (
             "prefill_tokens_dispatched",  # real prompt tokens run (not pad)
             "prefill_dispatches",
@@ -338,7 +342,6 @@ class InferenceEngineV2:
         # wire per dispatch, from qcomm.wire_bytes; 0 without a TP mesh).
         # The quant-comm bench diffs these across its passthrough/int8 twin
         # runs (comm_bytes_on_wire delta is the headline wire saving).
-        self._comm_ns = self.telemetry.claim_prefix("comm")
         self._comm_c = self.telemetry.counters(self._comm_ns, (
             "bytes_on_wire",  # transport payload + scale bytes per device
             # format-INDEPENDENT wire GSPMD inserts around the sharded

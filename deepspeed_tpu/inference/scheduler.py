@@ -54,9 +54,33 @@ sampling triple (temperature/top_k/top_p) — it is a static jit argument and
 the batch shares one dispatch.  Per-request ``stop_token`` and
 ``max_new_tokens`` are host-side and unrestricted.  The triple resets when
 the scheduler drains idle.
+
+Concurrency model (verified by ``analysis/racelint.py`` statically and
+``analysis/schedviz.py`` under deterministic interleavings): ``tick()`` is
+single-owner — exactly one thread drives the dispatch loop — but the
+INTAKE surface (``waiting``/``requests``/``_running`` membership, the
+sampling-triple election, uid allocation) is shared with whatever threads
+call ``try_submit``/``cancel``/``pop_result`` (the router thread, the
+roadmap's controller thread); a cancel landing mid-tick on a running
+request defers its release to the next tick boundary so the dispatch
+phases never lose a descriptor they are indexing.  ``adopt_prefilled``/
+``detach`` take the same lock but are HANDOFF-protocol calls: the
+migration sequence (extract → adopt → inject → detach) runs on the owner
+tick thread between ticks by design — a mid-tick cross-thread detach
+would free pages the in-flight dispatch still indexes, and its MIGRATED
+release cannot defer (the destination is already decoding the
+sequence).  One
+reentrant ``_lock`` guards that surface: intake methods and the tick
+phases that mutate queue membership (expire, admission, release, preempt)
+take it; the device-dispatch phases run OUTSIDE it, so a slow compile or
+forward pass never stalls a submit.  Without the lock, two concurrent
+submits on an idle scheduler can both win the triple election and
+co-schedule conflicting sampling triples (the lost-election race the
+interleaving harness replays deterministically).
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -132,6 +156,10 @@ class ServeRequest:
     ttft_deadline_ms: Optional[float] = None
     error: Optional[str] = None  # recorded cause for FAILED/TIMED_OUT
     retries: int = 0  # transient-failure retries charged to this request
+    # cancel() arrived mid-tick while this request was RUNNING: the release
+    # defers to the next tick boundary (expire phase) so the in-flight
+    # dispatch phases never lose the descriptor under their feet
+    cancel_requested: bool = False
 
 
 class ServeScheduler:
@@ -159,14 +187,31 @@ class ServeScheduler:
             else _coerce(ServeConfig, serve)
         self.faults = faults if faults is not None \
             else getattr(engine, "faults", None)
+        # the INTAKE lock: owns waiting/requests/_running membership, the
+        # sampling-triple election, and uid allocation — everything a
+        # non-owner thread (router, controller) may touch concurrently
+        # with the single-owner tick loop.  Reentrant because the release
+        # path nests under cancel/close.  Device-dispatch phases run
+        # outside it by design (a forward pass must never stall a submit).
+        self._lock = threading.RLock()
         self.waiting: "deque[ServeRequest]" = deque()
         self.requests: Dict[int, ServeRequest] = {}
         self._running: List[ServeRequest] = []  # admission order
+        # single-owner flag (written only by the tick thread): a cancel
+        # landing while True defers running requests' release to the next
+        # expire phase instead of freeing a descriptor the in-flight
+        # dispatch still indexes
+        self._in_tick = False
+        # terminal trace events recorded under the intake lock, fired
+        # OUTSIDE it by _flush_released: trace.finished writes the JSONL
+        # request summary, and disk I/O must never ride the intake lock
+        # (the blocking-under-lock class racelint exists to catch)
+        self._released_pending: List[ServeRequest] = []
         self.tick_no = 0
         self._triple = None  # shared device sampling triple
         self._uid_counter = 0
         self._spec_budget = self.prefill_chunk  # leftover chunk tokens/tick
-        self._admit_transient = False  # last _try_admit failed transiently
+        self._admit_transient = False  # last admit probe failed transiently
         # degradation state
         self._shed = False
         self._shed_span = None
@@ -205,11 +250,13 @@ class ServeScheduler:
 
     # -- request intake -----------------------------------------------------
     def next_uid(self) -> int:
-        while True:
-            self._uid_counter += 1
-            uid = self._uid_counter
-            if uid not in self.requests and uid not in self.engine.mgr.seqs:
-                return uid
+        with self._lock:
+            while True:
+                self._uid_counter += 1
+                uid = self._uid_counter
+                if uid not in self.requests \
+                        and uid not in self.engine.mgr.seqs:
+                    return uid
 
     def try_submit(
         self, uid: int, tokens: Sequence[int],
@@ -221,7 +268,20 @@ class ServeScheduler:
         whose reason distinguishes client error (``CLIENT_ERRORS``: the
         request is invalid outright) from backpressure (``RETRY_LATER``:
         shed mode — resubmit later).  Capacity that merely requires waiting
-        still queues (``QUEUED``)."""
+        still queues (``QUEUED``).  Safe from any thread: the whole
+        validate-elect-enqueue sequence holds the intake lock, so a
+        concurrent submit can neither double-win the triple election nor
+        interleave into the queue mid-validation."""
+        with self._lock:
+            return self._try_submit_locked(
+                uid, tokens, sampling, deadline_ms, ttft_deadline_ms)
+
+    def _try_submit_locked(
+        self, uid: int, tokens: Sequence[int],
+        sampling: SamplingParams,
+        deadline_ms: Optional[float],
+        ttft_deadline_ms: Optional[float],
+    ) -> SubmitResult:
         tokens = [int(t) for t in tokens]
         if uid in self.requests or uid in self.engine.mgr.seqs:
             # the mgr check covers put()-admitted sequences: deferring the
@@ -330,7 +390,21 @@ class ServeScheduler:
         transition.  Every terminal transition in the scheduler funnels
         through here — finish, failure, timeout, and cancel differ only in
         the state label and counters."""
+        with self._lock:
+            self._release_locked(req, state, error)
+
+    def _release_locked(self, req: ServeRequest, state: str,
+                        error: Optional[str]) -> None:
         assert state in TERMINAL, state
+        if req.state in TERMINAL:
+            return  # idempotent: a racing cancel/finish pair releases once
+        if req.cancel_requested and state in (FINISHED, FAILED):
+            # a deferred mid-tick cancel already promised True to its
+            # caller; the same tick finishing (or failing — the error
+            # stays recorded on the request) must not out-race it into a
+            # different terminal state (a client would double-process
+            # "cancelled" work it sees as FINISHED)
+            state = CANCELLED
         seq = self.engine.mgr.seqs.get(req.uid)
         if seq is not None:
             req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
@@ -355,7 +429,20 @@ class ServeScheduler:
             self._flt["cancelled"].inc()
         elif state == MIGRATED:
             self._c["migrated"].inc()
-        req.trace.finished(outcome=state)
+        # the terminal trace event writes the JSONL request summary —
+        # deferred to _flush_released so the disk write happens OUTSIDE
+        # the intake lock (tick end / intake-method exit)
+        self._released_pending.append(req)
+
+    def _flush_released(self) -> None:
+        """Fire the terminal trace events recorded by ``_release_locked``
+        — called with the intake lock NOT held (tick end and the public
+        intake methods' exits): a JSONL summary write under the lock
+        would stall every concurrent submit behind disk latency."""
+        with self._lock:
+            pending, self._released_pending = self._released_pending, []
+        for req in pending:
+            req.trace.finished(outcome=req.state)
 
     def _fail(self, req: ServeRequest, error: str, nan: bool = False) -> None:
         """Quarantine ``req``: typed FAILED terminal state with the error
@@ -369,11 +456,22 @@ class ServeScheduler:
         """Cancel a request from any non-terminal state (queued, mid-prefill
         chunk, decoding, mid-draft, preempted-back-to-queue).  Returns True
         if the request transitioned to ``CANCELLED``; False if it is unknown
-        or already terminal (too late to cancel)."""
-        req = self.requests.get(uid)
-        if req is None or req.state in TERMINAL:
-            return False
-        self._release(req, CANCELLED)
+        or already terminal (too late to cancel).  Safe from any thread —
+        the lookup and the release are one atomic step, so a cancel racing
+        the tick's own finish cannot double-release.  A cancel landing
+        MID-TICK on a running request defers its release to the next tick
+        boundary (the dispatch phases run outside the intake lock by
+        design, and must not lose a descriptor they are indexing); the
+        request may carry at most one more emitted token."""
+        with self._lock:
+            req = self.requests.get(uid)
+            if req is None or req.state in TERMINAL:
+                return False
+            if self._in_tick and req in self._running:
+                req.cancel_requested = True
+            else:
+                self._release_locked(req, CANCELLED, None)
+        self._flush_released()
         return True
 
     # -- prefill/decode disaggregation (the KV-handoff seam) -----------------
@@ -397,6 +495,16 @@ class ServeScheduler:
         for positions ``[0, n_ctx)`` before the next tick, then publish the
         prefix chain with ``mgr.update_hashes`` (serving/handoff.py wraps
         both)."""
+        with self._lock:
+            return self._adopt_prefilled_locked(
+                uid, tokens, n_ctx, sampling, deadline_ms, ttft_deadline_ms)
+
+    def _adopt_prefilled_locked(
+        self, uid: int, tokens: Sequence[int], n_ctx: int,
+        sampling: SamplingParams,
+        deadline_ms: Optional[float],
+        ttft_deadline_ms: Optional[float],
+    ) -> SubmitResult:
         tokens = [int(t) for t in tokens]
         if uid in self.requests or uid in self.engine.mgr.seqs:
             return SubmitResult(uid, REJECT_DUPLICATE_UID,
@@ -503,23 +611,43 @@ class ServeScheduler:
         release path — pages free locally (full cached blocks retire to the
         prefix LRU, warming future affinity hits), tokens stay on the
         request until popped.  Returns False if unknown/already
-        terminal."""
-        req = self.requests.get(uid)
-        if req is None or req.state in TERMINAL:
-            return False
-        self._release(req, MIGRATED)
-        return True
+        terminal.  OWNER-THREAD only, between ticks: migration is a
+        handoff-protocol step (extract -> adopt -> inject -> detach on one
+        thread) — unlike ``cancel`` it cannot defer mid-tick, because the
+        destination worker is already decoding the migrated sequence.  A
+        request with a DEFERRED CANCEL pending refuses migration: it is
+        released CANCELLED here (keeping the cancel's promise) and the
+        caller gets False — the router must then cancel the adopted copy
+        instead of completing the handoff."""
+        with self._lock:
+            req = self.requests.get(uid)
+            if req is None or req.state in TERMINAL:
+                return False
+            if req.cancel_requested:
+                self._release_locked(req, CANCELLED, None)
+                migrated = False
+            else:
+                self._release_locked(req, MIGRATED, None)
+                migrated = True
+        self._flush_released()
+        return migrated
 
     def close(self) -> None:
         """Drive every live request to a terminal state (CANCELLED) and
         empty the queue — the scheduler half of ``engine.close()``: all
         block/slot ownership goes back through the one ``_release`` path,
         so a torn-down trial engine cannot leak pages a later engine's
-        allocator would then double-own.  Idempotent."""
-        for uid in list(self.requests):
-            self.cancel(uid)
-        self.waiting.clear()
-        self._running.clear()
+        allocator would then double-own.  Idempotent.  Releases directly
+        (never the mid-tick deferral): teardown must not leave a deferred
+        cancel holding pages after the queues are cleared."""
+        with self._lock:
+            for uid in list(self.requests):
+                req = self.requests[uid]
+                if req.state not in TERMINAL:
+                    self._release_locked(req, CANCELLED, None)
+            self.waiting.clear()
+            self._running.clear()
+        self._flush_released()
 
     # -- deadlines ----------------------------------------------------------
     def _deadline_of(self, req: ServeRequest) -> Optional[float]:
@@ -535,20 +663,26 @@ class ServeScheduler:
         running): e2e deadline always applies; the TTFT deadline only until
         the first token lands.  Runs FIRST so an expired request's pages are
         back in the pool before this tick's admission."""
-        now = self._clock()
-        for req in list(self.waiting) + list(self._running):
-            if req.state in TERMINAL:
-                continue
-            waited_ms = (now - req.submit_time) * 1e3
-            dl = self._deadline_of(req)
-            if dl is not None and waited_ms > dl:
-                self._release(req, TIMED_OUT,
-                              error=f"e2e deadline {dl}ms exceeded")
-                continue
-            tdl = self._ttft_deadline_of(req)
-            if tdl is not None and not req.generated and waited_ms > tdl:
-                self._release(req, TIMED_OUT,
-                              error=f"ttft deadline {tdl}ms exceeded")
+        with self._lock:
+            now = self._clock()
+            for req in list(self.waiting) + list(self._running):
+                if req.state in TERMINAL:
+                    continue
+                if req.cancel_requested:
+                    # a cancel deferred from mid-tick lands here, at the
+                    # first safe boundary of the NEXT tick
+                    self._release_locked(req, CANCELLED, None)
+                    continue
+                waited_ms = (now - req.submit_time) * 1e3
+                dl = self._deadline_of(req)
+                if dl is not None and waited_ms > dl:
+                    self._release_locked(
+                        req, TIMED_OUT, f"e2e deadline {dl}ms exceeded")
+                    continue
+                tdl = self._ttft_deadline_of(req)
+                if tdl is not None and not req.generated and waited_ms > tdl:
+                    self._release_locked(
+                        req, TIMED_OUT, f"ttft deadline {tdl}ms exceeded")
 
     # -- transient-failure retry --------------------------------------------
     def _backoff(self, attempt: int) -> None:
@@ -563,7 +697,7 @@ class ServeScheduler:
                 r.retries += 1
 
     # -- admission ----------------------------------------------------------
-    def _try_admit(self, req: ServeRequest) -> bool:
+    def _try_admit_locked(self, req: ServeRequest) -> bool:
         mgr = self.engine.mgr
         if not mgr.free_slots:
             return False
@@ -612,32 +746,40 @@ class ServeScheduler:
         return True
 
     def _admit_phase(self) -> None:
-        mgr = self.engine.mgr
-        for req in list(self.waiting):
-            if not mgr.free_slots:
-                break
-            # admission outcome depends only on free slots, allocatable
-            # blocks, and cache contents (every content change bumps
-            # `registrations` or moves `available_blocks`): skip the full
-            # tentative-admit probe — an O(prompt) prefix walk — when none
-            # of that moved since this request was last denied.  PER-REPLICA
-            # availability, not the aggregate: balanced cross-replica churn
-            # (one replica frees N while another consumes N) changes where a
-            # request fits without moving any aggregate number.
-            state = (mgr.free_slots,
-                     tuple(a.available_blocks for a in mgr.allocators),
-                     mgr.allocator.registrations)
-            self._admit_transient = False
-            denied = req.denied_state == state or not self._try_admit(req)
-            if not denied:
-                self.waiting.remove(req)
-            else:
-                # a transiently-failed probe must NOT be memoized: the pool
-                # state it keyed on did not change, so the cache would deny
-                # the request forever once the transient cleared
-                req.denied_state = None if self._admit_transient else state
-                if self.tick_no - req.submit_tick >= self.starvation_ticks:
-                    break  # aged request: nothing may jump the queue past it
+        # one intake-lock scope for the whole scan: admission decides on a
+        # consistent queue snapshot, and a submit landing mid-scan waits
+        # for the next tick instead of being half-considered (the probe is
+        # pure host math — holding the lock across it is cheap)
+        with self._lock:
+            mgr = self.engine.mgr
+            for req in list(self.waiting):
+                if not mgr.free_slots:
+                    break
+                # admission outcome depends only on free slots, allocatable
+                # blocks, and cache contents (every content change bumps
+                # `registrations` or moves `available_blocks`): skip the full
+                # tentative-admit probe — an O(prompt) prefix walk — when none
+                # of that moved since this request was last denied.
+                # PER-REPLICA availability, not the aggregate: balanced
+                # cross-replica churn (one replica frees N while another
+                # consumes N) changes where a request fits without moving
+                # any aggregate number.
+                state = (mgr.free_slots,
+                         tuple(a.available_blocks for a in mgr.allocators),
+                         mgr.allocator.registrations)
+                self._admit_transient = False
+                denied = req.denied_state == state \
+                    or not self._try_admit_locked(req)
+                if not denied:
+                    self.waiting.remove(req)
+                else:
+                    # a transiently-failed probe must NOT be memoized: the
+                    # pool state it keyed on did not change, so the cache
+                    # would otherwise deny the request forever once the
+                    # transient cleared
+                    req.denied_state = None if self._admit_transient else state
+                    if self.tick_no - req.submit_tick >= self.starvation_ticks:
+                        break  # aged request: nothing may jump the queue
 
     # -- prefill ------------------------------------------------------------
     def _dispatch_prefill(self, entries, sampling) -> Dict[int, int]:
@@ -799,18 +941,19 @@ class ServeScheduler:
         """Preemption by recompute: drop the sequence's pages (full ones
         stay in the prefix-cache LRU) and requeue at the FRONT with prompt =
         all tokens so far — re-prefill is then mostly cache hits."""
-        seq = self.engine.mgr.seqs[req.uid]
-        req.tokens = list(seq.tokens)
-        # this incarnation's draft/accept totals die with the descriptor —
-        # fold them into the request trace before release
-        req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
-        req.trace.preempted()
-        self.engine.mgr.release(req.uid)
-        self._running.remove(req)
-        req.state = WAITING
-        req.preemptions += 1
-        self.waiting.appendleft(req)
-        self._c["preemptions"].inc()
+        with self._lock:
+            seq = self.engine.mgr.seqs[req.uid]
+            req.tokens = list(seq.tokens)
+            # this incarnation's draft/accept totals die with the
+            # descriptor — fold them into the request trace before release
+            req.trace.add_spec(seq.spec_drafted, seq.spec_accepted)
+            req.trace.preempted()
+            self.engine.mgr.release(req.uid)
+            self._running.remove(req)
+            req.state = WAITING
+            req.preemptions += 1
+            self.waiting.appendleft(req)
+            self._c["preemptions"].inc()
 
     @property
     def _speculating(self) -> bool:
@@ -974,8 +1117,10 @@ class ServeScheduler:
         return toks[: samp.max_new_tokens]
 
     def pop_result(self, uid: int) -> List[int]:
-        toks = self.result(uid)
-        del self.requests[uid]
+        with self._lock:
+            toks = self.result(uid)
+            del self.requests[uid]
+        self._flush_released()
         return toks
 
     # -- degradation (watchdog + sustained exhaustion) ----------------------
@@ -1059,19 +1204,26 @@ class ServeScheduler:
         timed-out / cancelled requests never appear in the returned dict —
         read their terminal state off ``requests[uid]``."""
         self.tick_no += 1
+        self._in_tick = True  # single-owner write: cancels now defer
         t0 = self._clock()  # BEFORE the fault delay: an injected stall must
         # land inside the watchdog's measured window or it cannot trip it
-        if self.faults is not None:
-            d = self.faults.delay("slow_tick")
-            if d > 0:
-                time.sleep(d)  # chaos harness: stalls the tick, trips the watchdog
-        self._expire_phase()
-        self._admit_phase()
-        decoding = [r for r in self._running if r.state == DECODE]
-        out = self._prefill_phase()
-        out.update(self._decode_phase(decoding))
-        self._update_degradation((self._clock() - t0) * 1e3)
-        return out
+        try:
+            if self.faults is not None:
+                d = self.faults.delay("slow_tick")
+                if d > 0:
+                    time.sleep(d)  # chaos harness: stalls the tick, trips the watchdog
+            self._expire_phase()
+            self._admit_phase()
+            decoding = [r for r in self._running if r.state == DECODE]
+            out = self._prefill_phase()
+            out.update(self._decode_phase(decoding))
+            self._update_degradation((self._clock() - t0) * 1e3)
+            return out
+        finally:
+            self._in_tick = False
+            # releases from the phases (finish/fail/expire) fire their
+            # JSONL trace summaries here, outside every lock
+            self._flush_released()
 
     def run(self, wait_for: Optional[Sequence[int]] = None,
             max_ticks: int = 1_000_000) -> Dict[int, List[int]]:

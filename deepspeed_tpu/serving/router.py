@@ -381,6 +381,8 @@ class Router:
         req = src.scheduler.requests.get(rec.uid)
         if req is None or req.state != sched_mod.DECODE or not req.generated:
             return  # still prefilling (or already terminal — collected below)
+        if req.cancel_requested:
+            return  # deferred cancel pending: never migrate doomed work
         targets = [w for w in self.pool.decode_workers
                    if not w.shedding and w is not src]
         seq = src.engine.mgr.seqs[rec.uid]
@@ -397,7 +399,14 @@ class Router:
             )
             if res.accepted:
                 handoff_mod.inject_request(tgt.engine, ho)
-                src.scheduler.detach(rec.uid)
+                if not src.scheduler.detach(rec.uid):
+                    # the source refused (a deferred cancel won the race
+                    # and released CANCELLED): kill the adopted copy and
+                    # let terminal collection pick the cancel up from src
+                    tgt.scheduler.cancel(rec.uid)
+                    tgt.scheduler.pop_result(rec.uid)
+                    rec.disagg = False
+                    return
                 src.scheduler.pop_result(rec.uid)
                 rec.worker = tgt.index
                 rec.disagg = False

@@ -29,7 +29,16 @@ Design constraints, in order:
    p99s, while an unbounded production stream degrades gracefully to the
    bucket estimate instead of growing host memory.
 3. **Thread safety** is per-metric locking: the serving loop, the prefetch
-   worker, and checkpoint threads all observe concurrently.
+   worker, and checkpoint threads all observe concurrently.  The registry
+   lock additionally owns the NAMESPACE MAP (``claim_prefix`` /
+   ``release_prefix``): claim, release, and the metric-table drop that
+   rides a release are one atomic step under ONE lock, so a concurrent
+   claimant can never re-register fresh metrics into a half-released
+   namespace and have them swept by the in-flight drop (the race the Graft
+   Race harness caught — see ``analysis/schedviz.py``
+   ``scenario_namespace_claims``).  The JSONL sink holds a DEDICATED lock:
+   file I/O never stalls ``counter()``/``snapshot()`` behind disk writes
+   (the blocking-under-lock class ``analysis/racelint.py`` flags).
 """
 from __future__ import annotations
 
@@ -284,6 +293,12 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # claimed metric namespaces ("serve", "serve2", ...) — owned by
+        # self._lock so claim/release/drop are one atomic step
+        self._prefixes: set = set()
+        # the JSONL sink serializes on its own lock: metric reads/writes
+        # must never wait on disk
+        self._sink_lock = threading.Lock()
         self._jsonl = None
 
     # -- metric handles -----------------------------------------------------
@@ -344,20 +359,65 @@ class MetricsRegistry:
                 events.append((f"{name}/p{q}", h.percentile(q), step))
         return events
 
+    # -- namespaces ---------------------------------------------------------
+    def _claim_locked(self, prefixes: Sequence[str]) -> List[str]:
+        """Smallest shared suffix at which EVERY prefix in the group is
+        free (caller holds the lock): bare names first, then ``2``, ``3``,
+        ... — the suffix is shared so paired namespaces (an engine's
+        ``serve``/``sched``/``comm``) can never interleave into a
+        mismatched pairing under concurrent construction."""
+        i = 1
+        while True:
+            suffix = "" if i == 1 else str(i)
+            cand = [p + suffix for p in prefixes]
+            if all(c not in self._prefixes for c in cand):
+                self._prefixes.update(cand)
+                return cand
+            i += 1
+
+    def claim_prefix(self, prefix: str) -> str:
+        """Unique metric namespace for one owner (``serve`` -> ``serve``,
+        then ``serve2``, ``serve3``, ...).  Atomic under the registry
+        lock."""
+        with self._lock:
+            return self._claim_locked((prefix,))[0]
+
+    def claim_prefixes(self, prefixes: Sequence[str]) -> List[str]:
+        """Claim a GROUP of namespaces atomically with one shared suffix
+        (``("serve", "sched")`` -> ``["serve2", "sched2"]``): an engine's
+        paired namespaces stay paired no matter how many engines are being
+        constructed concurrently on the shared instance."""
+        with self._lock:
+            return self._claim_locked(prefixes)
+
+    def release_prefix(self, prefix: str, drop_metrics: bool = True) -> int:
+        """Return a claimed namespace and (by default) drop its metrics —
+        ONE atomic step under the registry lock, so a concurrent claimant
+        reclaiming the name cannot register fresh metrics into the window
+        between the release and the sweep (they would be swept with the
+        dead engine's).  Returns how many metrics were dropped."""
+        with self._lock:
+            self._prefixes.discard(prefix)
+            return self._drop_prefix_locked(prefix + "/") if drop_metrics \
+                else 0
+
+    def _drop_prefix_locked(self, prefix: str) -> int:
+        n = 0
+        for table in (self._counters, self._gauges, self._histograms):
+            stale = [k for k in table if k.startswith(prefix)]
+            n += len(stale)
+            for k in stale:
+                del table[k]
+        return n
+
     def drop_prefix(self, prefix: str) -> int:
         """Delete every metric whose name starts with ``prefix`` (e.g.
         ``"serve/"``).  The namespace-release half of engine teardown: a
         later engine reclaiming the namespace re-registers FRESH metrics
         instead of inheriting a dead engine's counts into its stats view.
         Returns how many metrics were dropped."""
-        n = 0
         with self._lock:
-            for table in (self._counters, self._gauges, self._histograms):
-                stale = [k for k in table if k.startswith(prefix)]
-                n += len(stale)
-                for k in stale:
-                    del table[k]
-        return n
+            return self._drop_prefix_locked(prefix)
 
     def reset_histograms(self) -> None:
         """Drop every histogram's observations (counters/gauges keep their
@@ -375,16 +435,23 @@ class MetricsRegistry:
         rec = {"ts": self._time(), "event": name}
         rec.update(fields)
         line = json.dumps(rec, default=str)
-        with self._lock:
+        # the sink lock guards ONLY the file handle: lines from concurrent
+        # threads must not interleave mid-record, and that serialization
+        # necessarily spans the write — hence the documented allows.  The
+        # metrics lock is never held here, so counter/snapshot traffic
+        # proceeds while a record is on its way to disk.
+        with self._sink_lock:
             if self._jsonl is None:
-                self._jsonl = open(self.jsonl_path, "a", buffering=1)
-            self._jsonl.write(line + "\n")
+                self._jsonl = open(self.jsonl_path, "a", buffering=1)  # lint: allow(blocking-under-lock)
+            self._jsonl.write(line + "\n")  # lint: allow(blocking-under-lock)
 
     def close(self) -> None:
-        with self._lock:
-            if self._jsonl is not None:
-                self._jsonl.close()
-                self._jsonl = None
+        # detach under the sink lock, close OUTSIDE it: a slow fsync must
+        # not stall a concurrent event() (which will simply reopen-append)
+        with self._sink_lock:
+            fh, self._jsonl = self._jsonl, None
+        if fh is not None:
+            fh.close()
 
 
 class StatsView(MutableMapping):

@@ -522,7 +522,6 @@ class Telemetry:
         self._traces: "deque[RequestTrace]" = deque(maxlen=4096)
         self.traces_dropped = 0
         self._lock = threading.Lock()
-        self._prefixes: set = set()
         self._req_hists: Dict[str, Dict[str, Any]] = {}
         self._exit_registered = False
         # serve-request histograms (no-op singletons when disabled); the
@@ -553,17 +552,18 @@ class Telemetry:
         is shared between an engine and its scheduler by design; if a
         SECOND engine is constructed on the same instance, its counters
         must not alias the first's (``stats`` would read merged totals) —
-        the second claimant gets ``serve2/``, the third ``serve3/``, ..."""
-        with self._lock:
-            if prefix not in self._prefixes:
-                self._prefixes.add(prefix)
-                return prefix
-            i = 2
-            while f"{prefix}{i}" in self._prefixes:
-                i += 1
-            claimed = f"{prefix}{i}"
-            self._prefixes.add(claimed)
-            return claimed
+        the second claimant gets ``serve2/``, the third ``serve3/``, ...
+        The map itself lives in the registry under the ONE registry lock
+        (claim, release, and the metric drop riding a release are atomic
+        against each other)."""
+        return self.registry.claim_prefix(prefix)
+
+    def claim_prefixes(self, prefixes: Sequence[str]) -> List[str]:
+        """Claim a namespace GROUP atomically with one shared suffix —
+        an engine's paired ``serve``/``sched``/``comm`` namespaces stay
+        paired (``serve2`` with ``sched2``) even when several engines are
+        constructed concurrently on a shared instance."""
+        return self.registry.claim_prefixes(prefixes)
 
     def release_prefix(self, prefix: str, drop_metrics: bool = True) -> None:
         """Return a claimed namespace (engine teardown): the next claimant
@@ -572,12 +572,11 @@ class Telemetry:
         would otherwise grow an unbounded namespace tail.  With
         ``drop_metrics`` the namespace's registry metrics are deleted too,
         so reclaimed names start from zero rather than inheriting a dead
-        engine's counts."""
+        engine's counts — atomically with the release, so a concurrent
+        claimant's fresh metrics can never be swept by this drop."""
         with self._lock:
-            self._prefixes.discard(prefix)
             self._req_hists.pop(prefix, None)
-        if drop_metrics:
-            self.registry.drop_prefix(prefix + "/")
+        self.registry.release_prefix(prefix, drop_metrics=drop_metrics)
 
     # -- request traces -----------------------------------------------------
     def request_hists(self, ns: str) -> Dict[str, Any]:
