@@ -52,11 +52,15 @@ from .astlint import PKG_ROOT, _allowed
 
 # repo-relative prefixes/files under deepspeed_tpu/ the pass covers: the
 # concurrent host-side serving stack (ISSUE 13 scope) plus the one real
-# background thread in the repo (the input prefetcher)
+# background thread in the repo (the input prefetcher).  inference/ragged.py
+# joined with the replica-affine admission work (r14): StateManager's
+# placement/crediting paths run under the scheduler's intake lock, and the
+# lock-discipline inference must see them.
 RACE_SCOPE: Tuple[str, ...] = (
     "serving/",
     "inference/scheduler.py",
     "inference/engine_v2.py",
+    "inference/ragged.py",
     "telemetry/",
     "runtime/prefetch.py",
 )

@@ -571,6 +571,15 @@ class _StubMgr:
         self.prompt_tokens_total = 0
         self.cached_prompt_tokens = 0
 
+    def per_replica_token_budget(self, total: int) -> int:
+        return total  # replicas == 1
+
+    def hit_stats_snapshot(self) -> tuple:
+        return (self.prompt_tokens_total, self.cached_prompt_tokens)
+
+    def hit_stats_restore(self, snap: tuple) -> None:
+        self.prompt_tokens_total, self.cached_prompt_tokens = snap
+
     @property
     def free_slots(self) -> int:
         return self.max_seqs - len(self.seqs)
@@ -948,11 +957,113 @@ def scenario_kill_vs_route(seed: int, n_requests: int = 5) -> None:
         assert all(a.get("blocks_in_use", 0) == 0 for a in audits), audits
 
 
+def _replica_stub_scheduler(replicas: int = 2, telemetry=None, serve=None,
+                            **engine_kw):
+    """A real ``ServeScheduler`` over a :class:`HostStubEngine` whose state
+    manager is the REAL replica-partitioned ``StateManager`` (prefix
+    caching on) — host-only still, but admission placement, per-replica
+    allocators, prefix matching and the hash-publish path are the genuine
+    articles, so interleavings exercise the replica-affine admission code
+    rather than a stub approximation."""
+    from ..inference.ragged import StateManager
+    from ..inference.scheduler import ServeScheduler
+
+    eng = HostStubEngine(telemetry=telemetry, **engine_kw)
+    eng.mgr = StateManager(
+        num_blocks=engine_kw.get("num_blocks", 64),
+        block_size=engine_kw.get("block_size", 8),
+        max_seqs=engine_kw.get("max_seqs", 4),
+        enable_prefix_caching=True, replicas=replicas,
+    )
+    real_prefill = eng.prefill_entries
+
+    def prefill_entries(entries, sampling):
+        out = real_prefill(entries, sampling)
+        for seq, _s, _e in entries:
+            # publish the freshly "written" full blocks so later arrivals
+            # can prefix-match them — the engine does this per pack
+            eng.mgr.update_hashes(seq)
+        return out
+
+    eng.prefill_entries = prefill_entries
+    sched = ServeScheduler(eng, serve=serve)
+    eng.scheduler = sched
+    return eng, sched
+
+
+def scenario_replica_affine_admission(seed: int, n_requests: int = 6) -> None:
+    """Replica-affine admission vs cancel vs the owner tick loop on a real
+    replicas=2 ``StateManager`` with prefix caching: two submitters race
+    shared-prefix and cold prompts while a canceller fires mid-flight.
+    Invariants at every interleaving point: every tracked sequence's
+    blocks stay inside its owner replica's contiguous range (the property
+    the shard_map block-id translation relies on), the per-replica
+    allocators audit clean; at drain: every accepted request reached
+    exactly one terminal state and the pool leaks zero blocks."""
+    from ..inference.sampling import SamplingParams
+    from ..inference.scheduler import TERMINAL
+
+    sched = Schedule(seed, max_preemptions=32)
+    with sched.instrument():
+        eng, ss = _replica_stub_scheduler(replicas=2)
+        mgr = eng.mgr
+        accepted: List[int] = []
+        shared = [7] * 24  # three full blocks at bs=8: the affinity family
+
+        def affinity_invariant() -> None:
+            per = mgr._blocks_per
+            for seq in list(mgr.seqs.values()):
+                r = mgr.replica_of(seq)
+                blocks = list(seq.blocks)
+                assert all(r * per <= b < (r + 1) * per for b in blocks), (
+                    f"cross-replica block ref: replica {r}, blocks {blocks}")
+
+        def submitter(base: int) -> None:
+            for i in range(n_requests // 2):
+                uid = base + i
+                prompt = (shared + [uid, uid + 1] if i % 2 == 0
+                          else [uid % 251 + 1] * 12)
+                res = ss.try_submit(uid, prompt,
+                                    SamplingParams(max_new_tokens=2))
+                if res.accepted:
+                    accepted.append(uid)
+                affinity_invariant()
+
+        def ticker() -> None:
+            for _ in range(8):
+                ss.tick()
+                affinity_invariant()
+                mgr.allocator.audit()
+
+        def canceller() -> None:
+            ss.cancel(101)  # may be queued, running, or already terminal
+            ss.cancel(202)
+            affinity_invariant()
+
+        sched.spawn(submitter, 100, name="submitA")
+        sched.spawn(submitter, 200, name="submitB")
+        sched.spawn(ticker, name="tick")
+        sched.spawn(canceller, name="cancel")
+        sched.run()
+
+        for _ in range(64):  # drain on the owner thread
+            if all(ss.requests[u].state in TERMINAL for u in accepted):
+                break
+            ss.tick()
+        for u in accepted:
+            assert ss.requests[u].state in TERMINAL, u
+            ss.pop_result(u)
+        mgr.allocator.audit()
+        audit = eng.close()
+        assert audit["blocks_in_use"] == 0, audit
+
+
 SCENARIOS = (
     scenario_namespace_claims,
     scenario_submit_tick_cancel,
     scenario_shed_watchdog,
     scenario_kill_vs_route,
+    scenario_replica_affine_admission,
 )
 
 

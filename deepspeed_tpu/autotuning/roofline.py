@@ -169,10 +169,11 @@ def serving_feasible(cand: Dict[str, Any], model_cfg, base: Dict[str, Any],
         return False, (f"structural: num_heads {model_cfg.num_heads} "
                        f"not divisible by tp {tp}")
     if dp > 1:
-        if (cand.get("prefix_caching", base.get("enable_prefix_caching"))
-                or cand.get("prefill_chunk") or cand.get("spec")):
-            return False, ("structural: prefix caching / chunked prefill / "
-                           "speculation are not replica-aware (engine gate)")
+        # prefix caching / chunked prefill / speculation are replica-affine
+        # now (per-replica cache namespaces + replica-local ctx packs) —
+        # the old engine gate is gone, so the serve_replicas x
+        # {prefix_caching, prefill_chunk, spec} region of the grid is
+        # feasible and searchable; only the structural pool split remains
         if base.get("max_seqs", 0) % dp or base.get("num_blocks", 0) % dp:
             return False, "structural: max_seqs/num_blocks must divide replicas"
     if cand.get("quant_comm", "none") != "none" and tp <= 1:

@@ -138,7 +138,14 @@ def serving_space(
 ) -> SearchSpace:
     """Serving search space over the engine/scheduler knobs accumulated
     since PR 2.  Values mirror the ``InferenceEngineV2`` constructor
-    surface (see ``config.ServeEngineConfig``)."""
+    surface (see ``config.ServeEngineConfig``).
+
+    The ``serve_replicas × prefix_caching × prefill_chunk × spec`` region
+    is fully feasible since replica-affine serving retired the R>1
+    feature gates — ``roofline.serving_feasible`` only checks the
+    structural pool split (``max_seqs``/``num_blocks`` divisibility)
+    there, so R>1 candidates with caching/chunking/speculation on survive
+    the static prune and get measured."""
     return SearchSpace(
         knobs=[
             Knob("tp", tuple(tp)),

@@ -104,23 +104,16 @@ class InferenceEngineV2:
                     f"max_seqs ({max_seqs}) and num_blocks ({num_blocks}) "
                     f"must divide into {dp} serve replicas"
                 )
-            if enable_prefix_caching or prefill_chunk or enable_speculation:
-                raise NotImplementedError(
-                    "prefix caching, chunked prefill and speculation are "
-                    "not yet replica-aware: their context-attention packs "
-                    "read the pool through GSPMD gathers that a batch-"
-                    "sharded pool would route cross-replica — run those "
-                    "features with serve_replicas=1 (the multi-replica "
-                    "router PR lifts this)"
-                )
-            # Over-budget prompts are fully closed off at dp > 1: the
-            # scheduler rejects them with a typed SubmitResult
-            # (REJECT_PROMPT_OVER_BUDGET covers the worst-case requeue
-            # length too), and _run_packed_prefill refuses any ctx pack
-            # outright — prefill_packed_ctx's dense ctx gather would cross
-            # the batch-sharded pool under GSPMD (correct but not
-            # replica-local; the router front end is the sanctioned way to
-            # scale replicas with the full feature set).
+            # Prefix caching, chunked prefill and speculation are
+            # REPLICA-AFFINE at dp > 1 (nothing is gated any more):
+            # admission routes a prompt to the replica holding its deepest
+            # cached prefix (per-replica content-hash namespaces — keys
+            # chain on block ids, which are replica-partitioned, so the
+            # hash map partitions for free), ctx/verify packs are built as
+            # dp per-replica chunks, and their attention runs under
+            # shard_map with the same global→local block-id translation
+            # paged_attention_decode performs — no pack ever reads the
+            # pool across the batch axis.
         self.serve_replicas = dp
         # Quantized-weight serving (reference csrc/fp_quantizer + FP6 blog
         # 1.69-2.65x claim): big matmul kernels stored int8/fp8 with per-
@@ -274,6 +267,11 @@ class InferenceEngineV2:
                                 enable_prefix_caching=enable_prefix_caching,
                                 replicas=dp)
         self.mgr.faults = faults
+        # per-replica speculation totals [drafted, accepted] — the
+        # spec-accept half of the serve/replicaN/* gauge group (drafts and
+        # their accept-rate EMAs live on per-replica slots already; this
+        # only aggregates them by owner replica for the telemetry surface)
+        self._spec_by_replica = [[0, 0] for _ in range(dp)]
         self._scheduler = None
         # telemetry (telemetry/): ``stats`` is now a read-through view over
         # registry counters — same keys, same read semantics, and the
@@ -397,6 +395,7 @@ class InferenceEngineV2:
         # shard_map'd quant-matmul regions inside the compiled dispatches
         ctx_ = self.serving_ctx
         dp_ = self.serve_replicas
+        mesh_ = self._mesh
 
         # only the device-relevant sampling triple is static — hashing the
         # whole SamplingParams would recompile on max_new_tokens/stop_token
@@ -421,7 +420,7 @@ class InferenceEngineV2:
             continuation chunks).  Cold packs stay on ``packed_impl``."""
             logits, kv = model_runner.prefill_packed_ctx(
                 params, cfg_, tokens, seg, pos, pack_pages, last_idx,
-                ctx_tables, ctx_lens, kv, ctx=ctx_,
+                ctx_tables, ctx_lens, kv, ctx=ctx_, mesh=mesh_, dp=dp_,
             )
             t, k, p = sampling_triple
             sampled = sample(logits, SamplingParams(t, k, p), rng)
@@ -434,8 +433,6 @@ class InferenceEngineV2:
             ck = tuple(c.at[dst].set(c[src]) for c in ck)
             cv = tuple(c.at[dst].set(c[src]) for c in cv)
             return ck, cv
-
-        mesh_ = self._mesh
 
         def decode_impl(params, tokens, seq_lens, block_tables, active, kv,
                         rng, sampling_triple):
@@ -483,7 +480,7 @@ class InferenceEngineV2:
 
             logits, kv = model_runner.verify_packed_ctx(
                 params, cfg_, tokens, seg, pos, dst_pages, dst_offs,
-                ctx_tables, ctx_lens, kv, ctx=ctx_,
+                ctx_tables, ctx_lens, kv, ctx=ctx_, mesh=mesh_, dp=dp_,
             )
             k1 = draft.shape[1] + 1
             logits = logits.reshape(draft.shape[0], k1, -1)
@@ -705,11 +702,14 @@ class InferenceEngineV2:
         )
         return cls(params, cfg, **kw)
 
-    def can_schedule(self, prompt_lens: Sequence[int]) -> bool:
+    def can_schedule(self, prompt_lens: Sequence[int],
+                     token_lists=None) -> bool:
         # replica-aware: aggregate block counts would accept a batch that
         # fits the SUM of the per-replica pools but no single replica —
-        # the simulation mirrors admit's sequential placement exactly
-        return self.mgr.can_admit_all(prompt_lens)
+        # the simulation mirrors admit's sequential placement exactly.
+        # ``token_lists`` (optional) lets the simulation credit prefix-
+        # cached blocks the way admit(match_prefix=True) actually will.
+        return self.mgr.can_admit_all(prompt_lens, token_lists=token_lists)
 
     # -- serving API -------------------------------------------------------
     def put(
@@ -740,7 +740,8 @@ class InferenceEngineV2:
                     f"prompt length {len(toks)} exceeds max bucket "
                     f"{self.prefill_buckets[-1]}"
                 )
-        if not self.can_schedule([len(t) for t in token_lists]):
+        if not self.can_schedule([len(t) for t in token_lists],
+                                 token_lists=token_lists):
             raise RuntimeError(
                 f"cannot admit {len(token_lists)} sequences "
                 f"({sum(len(t) for t in token_lists)} tokens): "
@@ -748,7 +749,7 @@ class InferenceEngineV2:
             )
         entries = []
         admitted: List[int] = []
-        pt, ct = self.mgr.prompt_tokens_total, self.mgr.cached_prompt_tokens
+        snap = self.mgr.hit_stats_snapshot()
         try:
             for uid, toks in zip(uids, token_lists):
                 seq = self.mgr.admit(uid, toks)
@@ -761,8 +762,7 @@ class InferenceEngineV2:
             # stays admitted with never-written KV pages
             for u in admitted:
                 self.mgr.release(u)
-            self.mgr.prompt_tokens_total = pt
-            self.mgr.cached_prompt_tokens = ct
+            self.mgr.hit_stats_restore(snap)
             raise
         return self.prefill_entries(entries, sampling)
 
@@ -772,25 +772,44 @@ class InferenceEngineV2:
         every entry whose range completes its prompt (``end == len(tokens)``
         — mid-prompt chunks write KV but sample nothing).  ``start`` must be
         page-aligned: it is either a prefix-cache hit length or a prior
-        chunk boundary, both block-granular by construction."""
+        chunk boundary, both block-granular by construction.
+
+        Under ``serve_replicas > 1`` a pack is ``dp`` per-replica CHUNKS
+        (``_run_packed_prefill`` lays them out), so the budget is accounted
+        per replica at ``prefill_budget // dp`` tokens per chunk — the
+        whole dispatch then stays at the budget's compute size, and ctx
+        packs stay replica-local by construction.  An entry that overflows
+        ITS replica's chunk defers to the next pack alone (other replicas'
+        accumulating chunks are not flushed with it — each sequence
+        appears at most once per call, so deferral cannot reorder a
+        sequence's own chunks)."""
         out: Dict[int, int] = {}
         bs = self.block_size
-        pack: List = []
-        pack_len = 0
-        for entry in entries:
-            seq, start, end = entry
+        dp = self.serve_replicas
+        per_budget = self.mgr.per_replica_token_budget(self.prefill_budget)
+        for seq, start, _end in entries:
             if start % bs:
                 raise ValueError(
                     f"prefill start {start} not page-aligned (bs {bs})"
                 )
-            n = -(-(end - start) // bs) * bs
-            if pack and pack_len + n > self.prefill_budget:
-                self._run_packed_prefill(pack, sampling, out)
-                pack, pack_len = [], 0
-            pack.append(entry)
-            pack_len += n
-        if pack:
+        pending: List = list(entries)
+        while pending:
+            pack: List = []
+            pack_len = [0] * dp
+            deferred: List = []
+            for entry in pending:
+                seq, start, end = entry
+                n = -(-(end - start) // bs) * bs
+                r = self.mgr.replica_of(seq) if dp > 1 else 0
+                # an oversized single entry (> per_budget) rides an empty
+                # chunk — _run_packed_prefill buckets the pack up to fit
+                if pack_len[r] and pack_len[r] + n > per_budget:
+                    deferred.append(entry)
+                    continue
+                pack.append(entry)
+                pack_len[r] += n
             self._run_packed_prefill(pack, sampling, out)
+            pending = deferred
         return out
 
     def _run_packed_prefill(self, entries, sampling, out: Dict[int, int]) -> None:
@@ -802,24 +821,33 @@ class InferenceEngineV2:
         serializes (~100 ms/2048-token pack measured).  Cold packs (all
         starts 0) take the flash-kernel fast path; any non-zero start
         switches the pack to the context-aware dispatch that attends over
-        cached pages."""
+        cached pages.
+
+        Layout: the pack is ``serve_replicas`` equal chunks of one bucketed
+        size — replica ``r``'s entries fill [r*C, (r+1)*C) — and every row
+        group (segment ids, ctx tables/lens, last_idx, sampled logits) is
+        indexed by SLOT.  Slots and blocks partition contiguously per
+        replica, so a ctx pack's shard_map region resolves its chunk
+        entirely inside its local pool slice (paged.py translates the ids).
+        At ``serve_replicas == 1`` this degenerates to the classic single-
+        chunk layout byte-for-byte (one chunk, same bucket)."""
         self._maybe_fault("runner_exception", [s.uid for s, _, _ in entries])
         bs = self.block_size
-        total = sum(-(-(end - start) // bs) * bs for _, start, end in entries)
-        t_pad = _bucket(total, self.prefill_buckets)
-        if t_pad % bs:
+        dp = self.serve_replicas
+        groups: List[List] = [[] for _ in range(dp)]
+        for e in entries:
+            groups[self.mgr.replica_of(e[0]) if dp > 1 else 0].append(e)
+        chunk_tokens = max(
+            sum(-(-(end - start) // bs) * bs for _, start, end in g)
+            for g in groups
+        )
+        C = _bucket(max(chunk_tokens, bs), self.prefill_buckets)
+        if C % bs:
             raise ValueError(
-                f"prefill bucket {t_pad} must be a multiple of block_size {bs}"
+                f"prefill bucket {C} must be a multiple of block_size {bs}"
             )
+        t_pad = C * dp
         use_ctx = any(start > 0 for _, start, _ in entries)
-        if use_ctx and self.serve_replicas > 1:
-            raise NotImplementedError(
-                "context-attention prefill packs are not replica-local: "
-                "their dense ctx gather crosses the batch-sharded KV pool "
-                "under GSPMD — over-budget/continuation prefill needs "
-                "serve_replicas=1 (route replica scale through "
-                "serving.Router instead)"
-            )
         tokens = np.zeros(t_pad, np.int32)
         seg = np.zeros(t_pad, np.int32)
         pos = np.zeros(t_pad, np.int32)
@@ -827,22 +855,23 @@ class InferenceEngineV2:
         last_idx = np.full(self.mgr.max_seqs, -1, np.int32)
         ctx_tables = np.full((self.mgr.max_seqs, self.max_pages), -1, np.int32)
         ctx_lens = np.zeros(self.mgr.max_seqs, np.int32)
-        cur = 0
-        for j, (s, start, end) in enumerate(entries):
-            n = end - start
-            tokens[cur : cur + n] = s.tokens[start:end]
-            seg[cur : cur + n] = j + 1
-            pos[cur : cur + n] = np.arange(start, end)
-            n_pages = -(-n // bs)
-            first_page = start // bs
-            pack_pages[cur // bs : cur // bs + n_pages] = np.asarray(
-                s.blocks[first_page : first_page + n_pages]
-            )
-            if end == len(s.tokens):  # completes the prompt -> sample
-                last_idx[j] = cur + n - 1
-            ctx_tables[j, : len(s.blocks)] = s.blocks
-            ctx_lens[j] = start
-            cur += n_pages * bs  # next prompt starts page-aligned
+        for r, group in enumerate(groups):
+            cur = r * C
+            for s, start, end in group:
+                n = end - start
+                tokens[cur : cur + n] = s.tokens[start:end]
+                seg[cur : cur + n] = s.slot + 1
+                pos[cur : cur + n] = np.arange(start, end)
+                n_pages = -(-n // bs)
+                first_page = start // bs
+                pack_pages[cur // bs : cur // bs + n_pages] = np.asarray(
+                    s.blocks[first_page : first_page + n_pages]
+                )
+                if end == len(s.tokens):  # completes the prompt -> sample
+                    last_idx[s.slot] = cur + n - 1
+                ctx_tables[s.slot, : len(s.blocks)] = s.blocks
+                ctx_lens[s.slot] = start
+                cur += n_pages * bs  # next prompt starts page-aligned
         self._rng, sub = jax.random.split(self._rng)
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         n_real = sum(end - start for _, start, end in entries)
@@ -875,12 +904,12 @@ class InferenceEngineV2:
             [s.uid for s, _, end in entries if end == len(s.tokens)]
         )
         next_tokens = None
-        for j, (s, start, end) in enumerate(entries):
+        for s, start, end in entries:
             s.seen_tokens = end
             if end == len(s.tokens):
                 if next_tokens is None:
                     next_tokens = np.asarray(sampled)
-                tok = int(next_tokens[j])
+                tok = int(next_tokens[s.slot])
                 if s.uid in poison:
                     tok = -1
                 if tok < 0:
@@ -1276,6 +1305,9 @@ class InferenceEngineV2:
             self._c["spec_emitted"].inc(n_emit)
             s.spec_drafted += n
             s.spec_accepted += n_acc
+            rep = self._spec_by_replica[self.mgr.replica_of(s)]
+            rep[0] += n
+            rep[1] += n_acc
             if n > 0:
                 self._spec_update_throttle(s, n, n_acc)
             out[s.uid] = emitted
@@ -1580,6 +1612,37 @@ class InferenceEngineV2:
             self.kv, jnp.asarray(idx, jnp.int32),
             jax.tree_util.tree_map(jnp.asarray, pages),
         )
+
+    # -- per-replica telemetry ----------------------------------------------
+    def replica_stats(self) -> List[Dict[str, float]]:
+        """Host-side per-replica serving stats: the allocator/hit-rate rows
+        from the state manager plus this engine's speculation totals — the
+        exact figures ``update_replica_gauges`` publishes (benches and the
+        router's load surface read this directly; tests assert on it)."""
+        rows = self.mgr.replica_stats()
+        for r, row in enumerate(rows):
+            drafted, accepted = self._spec_by_replica[r]
+            row["spec_drafted"] = drafted
+            row["spec_accepted"] = accepted
+            row["spec_accept_rate"] = accepted / drafted if drafted else 0.0
+        return rows
+
+    def update_replica_gauges(self) -> None:
+        """Refresh the ``serve/replicaN/*`` gauges (prefix-hit rate, pool
+        headroom fraction, spec accept rate) from ``replica_stats`` — cheap
+        host math the paired scheduler runs once per tick on partitioned
+        engines, so cross-replica imbalance is visible to the bench, the
+        router's load surface, and the future online-tuning controller.
+        The names ride this engine's claimed ``serve`` prefix, so
+        ``release_prefix`` at close sweeps them with the rest."""
+        if not self.telemetry.enabled:
+            return  # registry.gauge() is a shared no-op when disabled
+        reg = self.telemetry.registry
+        for r, row in enumerate(self.replica_stats()):
+            pre = f"{self._ns}/replica{r}"
+            reg.gauge(f"{pre}/prefix_hit_rate").set(row["prefix_hit_rate"])
+            reg.gauge(f"{pre}/pool_headroom").set(row["headroom"])
+            reg.gauge(f"{pre}/spec_accept_rate").set(row["spec_accept_rate"])
 
     # -- teardown -----------------------------------------------------------
     def close(self) -> Dict[str, int]:

@@ -252,6 +252,8 @@ def prefill_packed_ctx(
     ctx_lens: jnp.ndarray,  # [N] int32 — cached-context length per segment
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
     ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
+    mesh=None,  # TP/2-D serving: shard_map the ctx attention (see paged.py)
+    dp: int = 1,  # batch-axis replicas — packs arrive as dp per-replica chunks
 ):
     """``prefill_packed`` generalized to token SUFFIXES: each packed segment
     starts at a per-sequence offset (``ctx_lens``) and attends over its
@@ -300,6 +302,7 @@ def prefill_packed_ctx(
         attn = paged_attention_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
+            mesh=mesh, dp=dp,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
@@ -324,6 +327,8 @@ def verify_packed_ctx(
     ctx_lens: jnp.ndarray,  # [N] int32 — committed (KV-written) length per slot
     kv_cache: Tuple[jnp.ndarray, jnp.ndarray],
     ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
+    mesh=None,  # TP/2-D serving: shard_map the ctx attention (see paged.py)
+    dp: int = 1,  # batch-axis replicas (slot-ordered rows chunk naturally)
 ):
     """Speculative-decode verify: score k+1 positions per sequence in ONE
     pass — the dispatch that amortizes the weight stream across several
@@ -374,6 +379,7 @@ def verify_packed_ctx(
         attn = paged_attention_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
+            mesh=mesh, dp=dp,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)

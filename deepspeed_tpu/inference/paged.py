@@ -94,7 +94,7 @@ def write_spec_kv(cache_layer, kv, pages, offsets):
 
 def paged_attention_packed_ctx(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
-    scale=None, logits_soft_cap=None,
+    scale=None, logits_soft_cap=None, mesh=None, dp: int = 1,
 ):
     """Packed-prefill attention where each pack segment ALSO attends to its
     sequence's cached KV pages (positions below its start offset) — the
@@ -102,11 +102,11 @@ def paged_attention_packed_ctx(
     ride on.
 
     q/k/v [T, h, hd] — the packed suffix tokens (page-aligned segments);
-    segment_ids [T] int32, 1-based per prompt, 0 = padding;
+    segment_ids [T] int32, 1-based per SLOT (slot + 1), 0 = padding;
     cache_*_layer [num_blocks, bs, hkv, hd] — pools WITH this pack's pages
     already written (the in-pack positions are masked out by ``ctx_lens``);
-    ctx_tables [N, P] int32 — block table per segment row (-1 padded);
-    ctx_lens [N] int32 — cached-context length per segment (start offset).
+    ctx_tables [N, P] int32 — block table per slot row (-1 padded);
+    ctx_lens [N] int32 — cached-context length per slot (start offset).
 
     One softmax spans [cached context | in-pack causal segment], keys in
     position order, so a suffix prefill over cached context is numerically
@@ -114,7 +114,131 @@ def paged_attention_packed_ctx(
     (gathers all P pages per segment, O(T * P * bs) logits) — ground truth
     for a future chunked-prefill Pallas kernel; the packed no-context fast
     path stays on ``flash_attention``.
+
+    With ``mesh`` the call runs under ``shard_map`` exactly like
+    :func:`paged_attention_decode`: q split on heads over ``model``, the
+    pool split on kv heads (replicated + narrowed when hkv doesn't divide
+    the axis).  ``dp > 1`` (the 2-D batch×model serve mesh) additionally
+    shards the PACK dimension over ``batch`` — the engine builds ctx packs
+    as ``dp`` equal per-replica chunks whose segments belong to that
+    replica's slot group, so each replica attends over its own chunk
+    against its LOCAL pool slice with the same global→local block-id
+    translation decode already performs.  Nothing reads the pool across
+    the batch axis.
     """
+    if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1):
+        return _paged_attention_packed_ctx_tp(
+            q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+            ctx_lens, mesh, dp=dp, scale=scale,
+            logits_soft_cap=logits_soft_cap,
+        )
+    return _paged_attention_packed_ctx_dense(
+        q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+        ctx_lens, scale=scale, logits_soft_cap=logits_soft_cap,
+    )
+
+
+def _paged_attention_packed_ctx_tp(
+    q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
+    mesh, dp=1, scale=None, logits_soft_cap=None,
+):
+    """Manual-region packed-ctx attention on the (batch, model) serve mesh.
+
+    Replica-locality contract (the engine's pack builder guarantees it):
+    chunk ``r`` of the pack ([r*T/dp, (r+1)*T/dp)) holds only segments of
+    replica ``r``'s slots, whose ctx rows are slots [r*N/dp, (r+1)*N/dp)
+    and whose block ids live in [r*nb/dp, (r+1)*nb/dp).  Each replica then
+    resolves its chunk entirely inside its local pool slice — block ids
+    translate by the constant slice offset, slot rows by the slot-group
+    offset — with no collective in the region at all (out rows shard the
+    same way the chunk does).
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import shard_map_compat
+    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS
+
+    tp = _model_axis_size(mesh)
+    t, hq, hd = q.shape
+    hkv = cache_k_layer.shape[2]
+    n = ctx_tables.shape[0]
+    if tp > 1 and hq % tp != 0:
+        raise ValueError(
+            f"model axis ({tp}) must divide num_heads ({hq}) for TP serving"
+        )
+    if dp > 1 and (t % dp or n % dp):
+        raise ValueError(
+            f"batch axis ({dp}) must divide the pack length ({t}) and the "
+            f"slot count ({n})"
+        )
+    kv_sharded = tp > 1 and hkv % tp == 0
+    head_axis = MODEL_AXIS if tp > 1 else None
+    kv_head_axis = MODEL_AXIS if kv_sharded else None
+    batch_axis = BATCH_AXIS if dp > 1 else None
+    q_spec = P(batch_axis, head_axis, None)
+    pk_spec = P(batch_axis, kv_head_axis, None)
+    pool_spec = P(batch_axis, None, kv_head_axis, None)
+    local = functools.partial(
+        _paged_attention_packed_ctx_dense, scale=scale,
+        logits_soft_cap=logits_soft_cap,
+    )
+    rows_per = n // dp
+
+    def body(q_l, k_l, v_l, seg, ck, cv, bt, sl):
+        if dp > 1:
+            r = jax.lax.axis_index(BATCH_AXIS)
+            # block ids are global inside the owner replica's contiguous
+            # range: translate by the local slice offset (same rule as the
+            # decode region; -1 padding stays out of range, masked by
+            # ctx_lens)
+            bt = jnp.where(bt >= 0, bt - r * ck.shape[0], -1)
+            # segment ids are global slot+1; this replica's ctx rows start
+            # at slot r * rows_per
+            seg = jnp.where(seg > 0, seg - r * rows_per, 0)
+        if kv_sharded or tp == 1:
+            return local(q_l, k_l, v_l, seg, ck, cv, bt, sl)
+        # replicated pool/pack kv (GQA, hkv % tp != 0): narrow both the
+        # pool AND the pack's fresh kv to this shard's q heads' kv head(s)
+        # so the local body sees an aligned GQA problem — the same
+        # alignment paged_attention_decode's inner performs
+        hq_l = q_l.shape[1]
+        i = jax.lax.axis_index(MODEL_AXIS)
+        if tp % hkv == 0:
+            k0 = i * hkv // tp
+            return local(
+                q_l,
+                jax.lax.dynamic_slice_in_dim(k_l, k0, 1, axis=1),
+                jax.lax.dynamic_slice_in_dim(v_l, k0, 1, axis=1),
+                seg,
+                jax.lax.dynamic_slice_in_dim(ck, k0, 1, axis=2),
+                jax.lax.dynamic_slice_in_dim(cv, k0, 1, axis=2),
+                bt, sl,
+            )
+        g_heads = i * hq_l + jnp.arange(hq_l)
+        kv_ids = g_heads * hkv // hq
+        return local(
+            q_l, jnp.take(k_l, kv_ids, axis=1), jnp.take(v_l, kv_ids, axis=1),
+            seg, jnp.take(ck, kv_ids, axis=2), jnp.take(cv, kv_ids, axis=2),
+            bt, sl,
+        )
+
+    return shard_map_compat(
+        body, mesh,
+        in_specs=(q_spec, pk_spec, pk_spec, P(batch_axis), pool_spec,
+                  pool_spec, P(batch_axis, None), P(batch_axis)),
+        out_specs=q_spec,
+    )(q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+      ctx_lens)
+
+
+def _paged_attention_packed_ctx_dense(
+    q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
+    scale=None, logits_soft_cap=None,
+):
+    """jnp reference body (single-shard): gathers all P pages per segment,
+    O(T * P * bs) logits."""
     t, hq, hd = q.shape
     nb, bs, hkv, _ = cache_k_layer.shape
     n, p = ctx_tables.shape

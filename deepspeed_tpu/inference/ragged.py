@@ -366,11 +366,29 @@ class StateManager:
         self.faults = None
         self.prompt_tokens_total = 0
         self.cached_prompt_tokens = 0
+        # per-replica splits of the two hit-rate counters above (replica r's
+        # numbers only ever move with its own admissions/re-matches) — the
+        # serve/replicaN/* telemetry and the bench's imbalance report read
+        # these through ``replica_stats``
+        self.prompt_tokens_by_replica = [0] * replicas
+        self.cached_tokens_by_replica = [0] * replicas
         self.cow_copies = 0
 
     @property
     def free_slots(self) -> int:
         return sum(len(g) for g in self._slot_groups)
+
+    def per_replica_token_budget(self, total: int) -> int:
+        """Per-replica share of a shared token budget (the scheduler's
+        prefill chunk, the engine's pack budget): ``total // replicas``
+        floored to page alignment with a one-page minimum; the identity at
+        ``replicas == 1``.  ONE implementation on purpose — scheduler
+        chunks and engine packs must round identically or scheduler-sized
+        chunks overflow engine per-replica chunks every tick."""
+        if self.replicas == 1:
+            return total
+        bs = self.block_size
+        return max(bs, (total // self.replicas) // bs * bs)
 
     def replica_of(self, seq: SequenceDescriptor) -> int:
         return seq.slot // self._slots_per
@@ -378,42 +396,126 @@ class StateManager:
     def _alloc_of(self, seq: SequenceDescriptor) -> BlockedAllocator:
         return self.allocators[self.replica_of(seq)]
 
-    def _pick_replica(self, prompt_len: int) -> Optional[int]:
-        """Admission placement: among replica groups with a free slot, the
-        one with the most immediately-allocatable blocks that can fit the
-        prompt (None when nobody fits) — the scheduler's per-replica batch
-        balancing rides on this single decision point."""
+    def _walk_chain(self, tokens, allocator: BlockedAllocator):
+        """THE content-chain walk: yield ``(key, block)`` for each cached
+        FULL leading block of ``tokens``, chaining each key on the matched
+        parent block, capped at ``(len - 1) // block_size`` (the final
+        prompt token always recomputes — see ``_match_prefix``).  Single
+        implementation by design: placement probes (``_probe_match``) and
+        allocation (``_match_prefix``) both ride it, so the two can never
+        desynchronize on the key scheme or the match cap."""
+        bs = self.block_size
+        parent: Optional[int] = None
+        for i in range((len(tokens) - 1) // bs):
+            key = block_key(parent, tuple(
+                int(t) for t in tokens[i * bs:(i + 1) * bs]))
+            b = allocator.lookup(key)
+            if b is None:
+                return
+            yield key, b
+            parent = b
+
+    def _probe_match(self, tokens,
+                     allocator: BlockedAllocator) -> Tuple[int, List[int]]:
+        """Non-mutating probe over ``_walk_chain``: no references taken.
+        Returns ``(matched_blocks, lru_blocks)`` where ``lru_blocks`` are
+        the matched blocks currently parked refcount-0 in the cached LRU —
+        admitting would revive them OUT of the available pool, so
+        feasibility must charge them even though no fresh allocation
+        happens.  Placement (``_pick_replica``) and the all-or-nothing
+        simulation (``can_admit_all``) both ride on this; the winning
+        replica's chain is re-walked once by ``_match_prefix`` at the real
+        admit (O(matched) dict lookups — the scheduler's denied-state memo
+        bounds repeat probes)."""
+        matched = 0
+        lru: List[int] = []
+        for _key, b in self._walk_chain(tokens, allocator):
+            matched += 1
+            if allocator.refcount(b) == 0:
+                lru.append(b)
+        return matched, lru
+
+    def _pick_replica(self, prompt_len: int,
+                      tokens=None) -> Optional[int]:
+        """Admission placement, replica-AFFINE for content: among replica
+        groups with a free slot that can fit the prompt, prefer the one
+        already holding its DEEPEST cached prefix (ties and the no-match
+        case fall back to most immediately-allocatable blocks — the
+        historical headroom balancing).  Feasibility credits the matched
+        run: only the fresh remainder needs allocating, plus the matched
+        LRU blocks a revival pulls out of the available pool.  None when
+        nobody fits — the scheduler's per-replica batch balancing and the
+        prefix-affinity routing both ride on this single decision point."""
         blocks = -(-prompt_len // self.block_size)
-        best, best_avail = None, -1
+        probe = self.enable_prefix_caching and tokens is not None
+        best, best_key = None, None
         for r in range(self.replicas):
             if not self._slot_groups[r]:
                 continue
-            avail = self.allocators[r].available_blocks
-            if avail >= blocks and avail > best_avail:
-                best, best_avail = r, avail
+            a = self.allocators[r]
+            matched, lru = (self._probe_match(tokens, a) if probe
+                            else (0, []))
+            if a.available_blocks < (blocks - matched) + len(lru):
+                continue
+            key = (matched, a.available_blocks)
+            if best_key is None or key > best_key:
+                best, best_key = r, key
         return best
 
-    def can_admit_all(self, prompt_lens) -> bool:
+    def can_admit_all(self, prompt_lens, token_lists=None) -> bool:
         """Whether ALL prompts can be admitted together: a greedy simulation
-        of the sequential per-replica placement ``admit`` performs (most-
-        headroom replica with a free slot that fits, in submission order).
-        Aggregate-pool arithmetic is NOT sufficient under replicas — a
-        prompt can fit the sum of two half-empty pools while fitting
-        neither — and the engine's all-or-nothing ``put()`` contract needs
-        the answer BEFORE the first admission mutates anything."""
+        of the sequential per-replica placement ``admit`` performs
+        (deepest-cached-prefix replica first, then most headroom, with a
+        free slot that fits, in submission order).  Aggregate-pool
+        arithmetic is NOT sufficient under replicas — a prompt can fit the
+        sum of two half-empty pools while fitting neither — and the
+        engine's all-or-nothing ``put()`` contract needs the answer BEFORE
+        the first admission mutates anything.
+
+        ``token_lists`` (same order as ``prompt_lens``) lets the simulation
+        credit prefix-matched blocks exactly the way
+        ``admit(match_prefix=True)`` will allocate: a matched run costs no
+        fresh blocks, matched LRU blocks are charged ONCE (the first
+        admission revives them; later sharers just take references).
+        Without tokens the simulation stays conservative (full block
+        count), which can spuriously reject admissible batches once the
+        cache is warm.
+
+        One un-modeled corner: the simulation probes every prompt against
+        the CURRENT cache, but a real earlier admission in the same batch
+        can evict LRU blocks a later prompt's credit assumed (the fresh
+        allocation outran the free list), flipping that prompt's
+        affinity placement and, in tight pools, its feasibility.  The
+        per-replica block charge itself is tight (matched LRU blocks are
+        a suffix of the matched run), but a True here is a strong
+        prediction, not a reservation — which is why ``put()`` keeps its
+        rollback path for pre-check defeats."""
         slots = [len(g) for g in self._slot_groups]
         avail = [a.available_blocks for a in self.allocators]
-        for n in prompt_lens:
+        probe = self.enable_prefix_caching and token_lists is not None
+        revived: set = set()  # LRU blocks already charged this simulation
+        for i, n in enumerate(prompt_lens):
             blocks = -(-int(n) // self.block_size)
-            best = -1
+            toks = token_lists[i] if probe else None
+            best, best_key, best_need, best_lru = -1, None, 0, ()
             for r in range(self.replicas):
-                if slots[r] and avail[r] >= blocks \
-                        and (best < 0 or avail[r] > avail[best]):
-                    best = r
+                if not slots[r]:
+                    continue
+                matched, lru = (self._probe_match(toks, self.allocators[r])
+                                if probe else (0, []))
+                fresh_lru = [b for b in lru if b not in revived]
+                need = (blocks - matched) + len(fresh_lru)
+                if avail[r] < need:
+                    continue
+                key = (matched, avail[r])
+                if best_key is None or key > best_key:
+                    best, best_key = r, key
+                    best_need, best_lru = need, fresh_lru
             if best < 0:
                 return False
             slots[best] -= 1
-            avail[best] -= blocks
+            avail[best] -= best_need
+            revived.update(best_lru)
         return True
 
     def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
@@ -421,8 +523,8 @@ class StateManager:
         need = seq.cur_len + new_tokens
         return max(0, -(-(need - have) // self.block_size))
 
-    def can_admit(self, prompt_len: int) -> bool:
-        return self._pick_replica(prompt_len) is not None
+    def can_admit(self, prompt_len: int, tokens=None) -> bool:
+        return self._pick_replica(prompt_len, tokens) is not None
 
     def _match_prefix(
         self, tokens: List[int], allocator: Optional[BlockedAllocator] = None
@@ -437,19 +539,12 @@ class StateManager:
         in the common single-replica case)."""
         if allocator is None:
             allocator = self.allocators[0]
-        bs = self.block_size
         blocks: List[int] = []
         keys: List[object] = []
-        parent: Optional[int] = None
-        for i in range((len(tokens) - 1) // bs):
-            key = block_key(parent, tuple(tokens[i * bs:(i + 1) * bs]))
-            b = allocator.lookup(key)
-            if b is None:
-                break
+        for key, b in self._walk_chain(tokens, allocator):
             allocator.ref(b)
             blocks.append(b)
             keys.append(key)
-            parent = b
         return blocks, keys
 
     def admit(self, uid: int, prompt_tokens: List[int],
@@ -463,7 +558,8 @@ class StateManager:
             raise ValueError(f"uid {uid} already tracked")
         if self.free_slots == 0:
             raise RuntimeError("no free sequence slots")
-        r = self._pick_replica(len(prompt_tokens))
+        r = self._pick_replica(len(prompt_tokens),
+                               prompt_tokens if match_prefix else None)
         if r is None:
             # keep the historical contract: slot exhaustion raises here,
             # block shortfall surfaces from allocate() below — pick any
@@ -478,7 +574,9 @@ class StateManager:
             seq.cached_tokens = len(seq.blocks) * self.block_size
             seq.seen_tokens = seq.cached_tokens
             self.cached_prompt_tokens += seq.cached_tokens
+            self.cached_tokens_by_replica[r] += seq.cached_tokens
         self.prompt_tokens_total += len(seq.tokens)
+        self.prompt_tokens_by_replica[r] += len(seq.tokens)
         self.seqs[uid] = seq
         return seq
 
@@ -569,6 +667,7 @@ class StateManager:
             seq.seen_tokens = (i + 1) * bs
             seq.cached_tokens = seq.seen_tokens
             self.cached_prompt_tokens += bs
+            self.cached_tokens_by_replica[self.replica_of(seq)] += bs
 
     def update_hashes(self, seq: SequenceDescriptor) -> None:
         """Publish every newly-FULL block of ``seq`` (prompt and generated
@@ -606,6 +705,42 @@ class StateManager:
             b = seq.blocks[i]
             if alloc.key_of(b) == seq.hashes[i]:
                 alloc.invalidate(b)
+
+    def hit_stats_snapshot(self) -> tuple:
+        """The hit-rate counter state (aggregate + per-replica splits) as
+        one opaque value — probe paths (tentative admits, adoption) save it
+        before ``admit`` and hand it back to :meth:`hit_stats_restore` on
+        rollback so the prefix-hit telemetry never counts a request twice
+        or counts one that was never really admitted."""
+        return (self.prompt_tokens_total, self.cached_prompt_tokens,
+                tuple(self.prompt_tokens_by_replica),
+                tuple(self.cached_tokens_by_replica))
+
+    def hit_stats_restore(self, snap: tuple) -> None:
+        self.prompt_tokens_total, self.cached_prompt_tokens = snap[0], snap[1]
+        self.prompt_tokens_by_replica = list(snap[2])
+        self.cached_tokens_by_replica = list(snap[3])
+
+    def replica_stats(self) -> List[Dict[str, float]]:
+        """Per-replica serving-health rows (one dict per replica): pool
+        occupancy and the prefix-hit split — the host-side source for the
+        ``serve/replicaN/*`` gauges and the bench's imbalance report."""
+        out: List[Dict[str, float]] = []
+        for r, a in enumerate(self.allocators):
+            pt = self.prompt_tokens_by_replica[r]
+            ct = self.cached_tokens_by_replica[r]
+            out.append(dict(
+                free_blocks=a.free_blocks,
+                cached_blocks=a.cached_blocks,
+                available_blocks=a.available_blocks,
+                total_blocks=a.total_blocks,
+                free_slots=len(self._slot_groups[r]),
+                prompt_tokens=pt,
+                cached_prompt_tokens=ct,
+                prefix_hit_rate=(ct / pt if pt else 0.0),
+                headroom=a.available_blocks / a.total_blocks,
+            ))
+        return out
 
     def release(self, uid: int) -> None:
         seq = self.seqs.pop(uid)

@@ -103,6 +103,9 @@ QUEUED = "queued"
 REJECT_DUPLICATE_UID = "duplicate_uid"
 REJECT_EMPTY_PROMPT = "empty_prompt"
 REJECT_PROMPT_TOO_LONG = "prompt_too_long"
+# retired as of the replica-affine serving PR (continuation prefill packs
+# are replica-local now, so over-budget prompts queue normally at any
+# serve_replicas) — kept for front ends that branch on historical reasons
 REJECT_PROMPT_OVER_BUDGET = "prompt_over_budget"
 REJECT_POOL_IMPOSSIBLE = "pool_impossible"
 REJECT_SAMPLING_CONFLICT = "sampling_conflict"
@@ -210,7 +213,12 @@ class ServeScheduler:
         self.tick_no = 0
         self._triple = None  # shared device sampling triple
         self._uid_counter = 0
-        self._spec_budget = self.prefill_chunk  # leftover chunk tokens/tick
+        # leftover chunk tokens per tick PER REPLICA (replica -> tokens):
+        # chunked prefill and speculation share one per-tick token headroom,
+        # and on a partitioned pool each replica group accounts its own
+        # share (a tick saturated by one replica's prompt chunks must not
+        # silence every other replica's drafts)
+        self._spec_budget: Dict[int, int] = {}
         self._admit_transient = False  # last admit probe failed transiently
         # degradation state
         self._shed = False
@@ -300,22 +308,10 @@ class ServeScheduler:
         # the request must fit the pool ALONE at its maximum length — prompt
         # plus full generation budget — or decode growth eventually exhausts
         # the pool with no victim left to preempt and the whole loop dies.
+        # (Over-budget prompts at serve_replicas > 1 queue like anyone else
+        # now: continuation prefill packs are replica-local — the PR 12
+        # REJECT_PROMPT_OVER_BUDGET gate is retired.)
         max_len = min(len(tokens) + sampling.max_new_tokens, eng.max_seq_len)
-        if eng.serve_replicas > 1 and max_len > eng.prefill_budget:
-            # a prompt (or its worst-case preempted requeue, which
-            # re-prefills prompt + everything generated) longer than one
-            # pack's budget would chunk into context-attention packs whose
-            # dense ctx gather crosses the batch-sharded pool — typed
-            # refusal instead of a silent cross-replica gather (route
-            # replica scale through serving.Router for the full feature
-            # set)
-            return SubmitResult(
-                uid, REJECT_PROMPT_OVER_BUDGET,
-                f"prompt + max_new_tokens ({max_len}) exceeds the prefill "
-                f"budget ({eng.prefill_budget}) on a serve_replicas="
-                f"{eng.serve_replicas} engine: continuation prefill packs "
-                "are not replica-local",
-            )
         blocks = -(-max_len // eng.block_size)
         # a sequence lives entirely inside ONE replica's block range, so the
         # feasibility bound is the per-replica pool, not the aggregate
@@ -525,16 +521,6 @@ class ServeScheduler:
                 f"adopted length {len(tokens)} leaves no room to decode "
                 f"(max_seq_len {eng.max_seq_len})",
             )
-        if eng.serve_replicas > 1 and max_len > eng.prefill_budget:
-            # same guard as try_submit: a preempted requeue of this request
-            # would re-prefill in ctx chunks the replica-partitioned pool
-            # refuses — reject typed here, not NotImplementedError mid-tick
-            return SubmitResult(
-                uid, REJECT_PROMPT_OVER_BUDGET,
-                f"adopted worst-case length ({max_len}) exceeds the prefill "
-                f"budget ({eng.prefill_budget}) on a serve_replicas="
-                f"{eng.serve_replicas} engine",
-            )
         blocks = -(-max_len // eng.block_size)
         pool = eng.mgr.allocator.total_blocks // eng.mgr.replicas
         if blocks > pool:
@@ -564,10 +550,11 @@ class ServeScheduler:
                                 retry_after_ms=self.retry_after_ms())
         # fresh exclusively-owned pages (match_prefix=False): injection is
         # about to overwrite them, so cache sharing would stomp live blocks
-        pt, ct = mgr.prompt_tokens_total, mgr.cached_prompt_tokens
+        snap = mgr.hit_stats_snapshot()
         seq = mgr.admit(uid, tokens, match_prefix=False)
         fresh = -(-len(tokens) // mgr.block_size)
-        headroom = self._watermark_blocks if self._running else 0
+        headroom = self._watermark_blocks \
+            if self._replica_busy(mgr, seq) else 0
         ok = fresh + headroom <= mgr._alloc_of(seq).available_blocks
         if ok:
             try:
@@ -579,7 +566,7 @@ class ServeScheduler:
         # never prefills it (KV is injected) — letting the admit's bump
         # stand would deflate the pool-aggregate prefix_hit_rate with a
         # phantom full-prompt miss per migration
-        mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
+        mgr.hit_stats_restore(snap)
         if not ok:
             mgr.release(uid)
             return SubmitResult(
@@ -697,25 +684,44 @@ class ServeScheduler:
                 r.retries += 1
 
     # -- admission ----------------------------------------------------------
+    def _replica_busy(self, mgr, seq) -> bool:
+        """Whether the watermark's decode-growth headroom applies to
+        ``seq``'s replica: some RUNNING request's sequence lives in the same
+        replica group (growth in another replica's range cannot touch this
+        pool slice, so its headroom reservation would only starve
+        admission).  Single-replica managers keep the historical rule —
+        any running batch at all."""
+        if mgr.replicas == 1:
+            return bool(self._running)
+        r = mgr.replica_of(seq)
+        for other in self._running:
+            s = mgr.seqs.get(other.uid)
+            if s is not None and s is not seq and mgr.replica_of(s) == r:
+                return True
+        return False
+
     def _try_admit_locked(self, req: ServeRequest) -> bool:
         mgr = self.engine.mgr
         if not mgr.free_slots:
             return False
         total_blocks = -(-len(req.tokens) // mgr.block_size)
-        # tentative admit performs the prefix match (refs cached blocks);
-        # roll it — and its hit-rate counters — back if the fresh remainder
-        # does not fit under the watermark
-        pt, ct = mgr.prompt_tokens_total, mgr.cached_prompt_tokens
+        # tentative admit performs the replica-affine placement AND the
+        # prefix match (refs cached blocks); roll it — and its hit-rate
+        # counters — back if the fresh remainder does not fit under the
+        # watermark
+        snap = mgr.hit_stats_snapshot()
         seq = mgr.admit(req.uid, req.tokens)
         fresh = total_blocks - len(seq.blocks)
         # the watermark reserves decode-growth headroom, but only while a
-        # running batch exists to grow — an idle pool admits to the brim.
+        # running batch exists IN THIS REPLICA to grow — an idle pool (or
+        # an idle replica of a partitioned pool) admits to the brim.
         # Checked against the CHOSEN replica's allocator: aggregate headroom
         # in another replica's range cannot serve this sequence's growth.
-        headroom = self._watermark_blocks if self._running else 0
+        headroom = self._watermark_blocks \
+            if self._replica_busy(mgr, seq) else 0
         if fresh + headroom > mgr._alloc_of(seq).available_blocks:
             mgr.release(req.uid)
-            mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
+            mgr.hit_stats_restore(snap)
             return False
         try:
             mgr.ensure_capacity(seq, 0)  # reserve every prompt page up front
@@ -723,7 +729,7 @@ class ServeScheduler:
             # roll the tentative admit back cleanly — admission is a probe,
             # never a place to crash the loop
             mgr.release(req.uid)
-            mgr.prompt_tokens_total, mgr.cached_prompt_tokens = pt, ct
+            mgr.hit_stats_restore(snap)
             if is_transient(e):
                 # transient reservation failure (injected allocator race):
                 # retry next tick.  The flag keeps _admit_phase from
@@ -858,12 +864,23 @@ class ServeScheduler:
         out: Dict[int, int] = {}
         bs = self.engine.block_size
         mgr = self.engine.mgr
-        budget = self.prefill_chunk
+        R = mgr.replicas
+        # the chunk budget is accounted PER REPLICA: packs are built as
+        # per-replica chunks at R > 1 (engine.prefill_entries), so each
+        # replica group gets its proportional share of the tick's prompt
+        # tokens — one replica's long prompt cannot starve another's.
+        # Shared rounding with the engine's pack budget (ragged.py) so a
+        # scheduler-sized chunk always fits one engine per-replica chunk.
+        per_chunk = mgr.per_replica_token_budget(self.prefill_chunk)
+        budgets = {r: per_chunk for r in range(R)}
         entries = []
         for req in list(self._running):  # _fail below mutates _running
-            if req.state != PREFILL or budget < bs:
+            if req.state != PREFILL:
                 continue
             seq = mgr.seqs[req.uid]
+            r = mgr.replica_of(seq)
+            if budgets[r] < bs:
+                continue
             # pick up prefix blocks published since admission (a request
             # queued behind the cold request that is WRITING its prefix
             # would otherwise recompute it)
@@ -877,19 +894,20 @@ class ServeScheduler:
                 self._fail(req, seq.error or "non-finite logits in prefill",
                            nan=seq.error is not None)
                 continue
-            take = min(remaining, budget)
+            take = min(remaining, budgets[r])
             if take < remaining:
                 take -= take % bs  # chunk boundaries stay page-aligned
                 if take == 0:
                     continue
             entries.append((seq, start, start + take))
-            budget -= take
+            budgets[r] -= take
         # leftover chunk tokens become this tick's speculative-draft budget:
         # drafting k tokens costs a k+1-position verify forward, so DRAFTED
         # tokens (not emitted ones) share the admission headroom chunked
         # prefill already accounts in — a tick saturated by prompt chunks
-        # speculates less, an idle-prefill tick speculates up to the chunk
-        self._spec_budget = max(0, budget)
+        # speculates less, an idle-prefill tick speculates up to the chunk.
+        # Per replica, like the chunk budget it is the remainder of.
+        self._spec_budget = {r: max(0, b) for r, b in budgets.items()}
         if not entries:
             return out
         clock = self.telemetry.clock
@@ -1016,16 +1034,26 @@ class ServeScheduler:
         mgr = eng.mgr
         # draft proposals for this tick, bounded by the prefill chunk's
         # leftover token budget (speculation and chunked prefill share one
-        # per-tick headroom, accounted in DRAFTED tokens); per-request
+        # per-tick headroom, accounted in DRAFTED tokens PER REPLICA — one
+        # plan call per replica group, so a prompt-saturated replica sheds
+        # its own drafts without silencing the others); per-request
         # remaining max_new_tokens clamps inside plan_speculation so
         # clamped-away drafts never debit the shared budget
         decode_live = [r for r in decoding if r.state == DECODE]
-        proposals = eng.plan_speculation(
-            [mgr.seqs[r.uid] for r in decode_live],
-            max_total_draft_tokens=self._spec_budget,
-            max_emit={r.uid: r.sampling.max_new_tokens - len(r.generated)
-                      for r in decode_live},
-        ) if self._speculating else {}
+        proposals: Dict[int, List[int]] = {}
+        if self._speculating:
+            by_replica: Dict[int, List[ServeRequest]] = {}
+            for req in decode_live:
+                r = mgr.replica_of(mgr.seqs[req.uid])
+                by_replica.setdefault(r, []).append(req)
+            for r, reqs in by_replica.items():
+                proposals.update(eng.plan_speculation(
+                    [mgr.seqs[q.uid] for q in reqs],
+                    max_total_draft_tokens=self._spec_budget.get(
+                        r, self.prefill_chunk),
+                    max_emit={q.uid: q.sampling.max_new_tokens
+                              - len(q.generated) for q in reqs},
+                ))
         for req in decoding:
             if req.state != DECODE:  # preempted by an earlier victim pick
                 continue
@@ -1218,6 +1246,13 @@ class ServeScheduler:
             out = self._prefill_phase()
             out.update(self._decode_phase(decoding))
             self._update_degradation((self._clock() - t0) * 1e3)
+            if self.engine.mgr.replicas > 1:
+                # per-replica hit/headroom/spec-accept gauges: cheap host
+                # math, refreshed at the tick boundary (engine doubles
+                # without the method — schedviz stubs — just skip)
+                up = getattr(self.engine, "update_replica_gauges", None)
+                if up is not None:
+                    up()
             return out
         finally:
             self._in_tick = False

@@ -105,12 +105,14 @@ def test_serving_feasibility_mirrors_engine_gates():
     ok, why = roofline.serving_feasible(
         {"tp": 4, "serve_replicas": 2}, cfg, base, 4)
     assert not ok and "devices" in why
-    # replica-aware feature gates (engine raises NotImplementedError there)
+    # replica-affine serving: caching / chunked prefill / speculation are
+    # feasible at serve_replicas > 1 now (the engine gate is retired), so
+    # the R>1 region of the grid must survive the static prune
     for knob in ({"spec": True}, {"prefill_chunk": 32},
                  {"prefix_caching": True}):
         ok, why = roofline.serving_feasible(
             {"tp": 1, "serve_replicas": 2, **knob}, cfg, base, 8)
-        assert not ok and "replica" in why
+        assert ok, why
     # replica divisibility of the pool
     ok, why = roofline.serving_feasible(
         {"tp": 1, "serve_replicas": 2}, cfg,
@@ -426,9 +428,10 @@ def test_autotune_model_smoke_winner_roundtrips_config():
 
 
 def test_bench_autotune_serving_smoke_inproc(tmp_path, capsys):
-    """The fast-lane `--autotune --smoke` CLI path: <= 4 measured trials
-    on the stub-sized workload, leaderboard written, >= 50% of the grid
-    pruned before any trial, winner >= the hand-tuned incumbent."""
+    """The fast-lane `--autotune --smoke` CLI path: a bounded number of
+    measured trials on the stub-sized workload, leaderboard written, the
+    un-gated serve_replicas>1 x caching region actually measured, winner
+    >= the hand-tuned incumbent."""
     import importlib.util
     import os
 
@@ -443,13 +446,20 @@ def test_bench_autotune_serving_smoke_inproc(tmp_path, capsys):
     payload = json.loads(line)
     assert payload["metric"] == "autotune_serving_winner_effective_tokens_per_sec"
     extra = payload["extra"]
-    assert extra["measured_trials"] <= 4
-    assert extra["pruned_fraction"] >= 0.5
+    assert extra["measured_trials"] <= 7  # max_trials=6 + the incumbent
+    # the static model still prunes (the R=3 indivisible-pool region)
+    assert extra["pruned_fraction"] > 0
     assert payload["value"] >= extra["incumbent_tokens_per_sec"]
     board = json.loads(open(out).read())
     assert board["candidates"] == len(board["trials"])
     for row in board["trials"]:
         assert set(row) >= {"candidate", "predicted_cost", "verdict", "score"}
+    # replica-affine serving opened the R>1 x caching/spec grid region:
+    # the smoke search must measure at least one such candidate
+    assert any(row["score"] is not None
+               and int(row["candidate"].get("serve_replicas", 1)) > 1
+               and row["candidate"].get("prefix_caching")
+               for row in board["trials"])
 
 
 @pytest.mark.slow
@@ -473,6 +483,10 @@ def test_full_serving_search_with_halving():
         rungs=(0.5, 1.0), top_k=4, eta=2, seed=0,
     )
     assert winner is not None and winner.rung == 1
-    assert tuner.pruned_fraction >= 0.5
+    # the serve_replicas x caching/spec region is feasible now (replica-
+    # affine serving un-gated it), so the static prune no longer halves
+    # this grid; the R>1 candidates must instead SURVIVE feasibility
+    assert any(int(t.candidate.get("serve_replicas", 1)) > 1
+               and t.verdict == "ok" for t in trials)
     # promoted trials were measured at both rungs
     assert any(len(t.run_order) == 2 for t in trials)

@@ -179,10 +179,13 @@ def test_2d_mesh_rejects_bad_wiring(gqa_model):
         InferenceEngineV2(params, model.cfg, grid=grid2, serve_replicas=2,
                           max_seqs=3, num_blocks=64, block_size=8,
                           prefill_buckets=(16,))
-    # features that read the pool cross-replica are gated, loudly
-    with pytest.raises(NotImplementedError, match="replica"):
-        InferenceEngineV2(params, model.cfg, grid=grid2, serve_replicas=2,
-                          enable_prefix_caching=True, **kw)
+    # prefix caching / chunked prefill / speculation construct fine at
+    # R>1 now — replica-affine serving retired the old NotImplementedError
+    # gate (tests/test_replica_affinity.py covers the behavior end to end)
+    eng = InferenceEngineV2(params, model.cfg, grid=grid2, serve_replicas=2,
+                            enable_prefix_caching=True, prefill_chunk=16,
+                            enable_speculation=True, **kw)
+    assert eng.enable_prefix_caching and eng.enable_speculation
 
 
 def test_tp_serving_with_quantized_weights(gqa_model):

@@ -3,7 +3,8 @@ workers (affinity hit-rate >= least-loaded baseline, zero allocator leaks
 after drain, greedy token-identity vs a single-engine reference),
 prefill/decode disaggregation via the paged-KV handoff (exact and int8
 wire), worker-kill re-route + replay, SLO backpressure (retry_after_ms
-hints, front-door shed), and the dp>1 over-budget typed reject."""
+hints, front-door shed), and dp>1 over-budget prompts served through
+replica-local ctx packs (the PR 12 typed reject, retired)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -321,7 +322,9 @@ def test_router_front_door_shed(tiny):
 
 
 # ---------------------------------------------------------------------------
-# dp>1 over-budget close-out (the PR 7 documented gap)
+# dp>1 over-budget close-out, round two: the PR 12 typed reject is RETIRED —
+# continuation prefill packs are replica-local now, so over-budget prompts
+# queue and serve at any serve_replicas
 # ---------------------------------------------------------------------------
 @pytest.fixture
 def dp2_engine(tiny):
@@ -337,30 +340,41 @@ def dp2_engine(tiny):
     eng.close()
 
 
-def test_dp2_over_budget_prompt_rejected_typed(dp2_engine):
+def test_dp2_over_budget_prompt_served_token_identical(dp2_engine, tiny):
+    """A prompt past the prefill budget on a serve_replicas=2 engine chunks
+    into replica-local ctx packs instead of being rejected — and decodes
+    exactly what the single-replica engine does."""
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6] * 4  # 32 + 8 new > budget 32: chunks
     sched = dp2_engine.scheduler
-    # 30 prompt + 8 new = 38 > budget 32: typed reject, not a silent
-    # cross-replica ctx gather
-    res = sched.try_submit(1, [3] * 30,
-                           SamplingParams(temperature=0.0, max_new_tokens=8))
-    assert res.reason == sched_mod.REJECT_PROMPT_OVER_BUDGET
-    assert res.reason in sched_mod.CLIENT_ERRORS
-    # within budget still queues
-    res = sched.try_submit(2, [3] * 20,
-                           SamplingParams(temperature=0.0, max_new_tokens=8))
-    assert res.accepted
+    res = sched.try_submit(1, prompt, samp)
+    assert res.accepted, res
+    sched.run(wait_for=[1])
+    assert sched.requests[1].state == "finished"
+    got = sched.pop_result(1)
+    solo = InferenceEngineV2(
+        params, cfg, max_seqs=4, num_blocks=64, block_size=8,
+        prefill_buckets=(16, 32), prefill_budget=32, max_seq_len=256)
+    want = solo.generate(prompt, samp)
+    solo.close()
+    assert got == want
+    dp2_engine.mgr.allocator.audit()
 
 
-def test_dp2_ctx_pack_refused_loudly(dp2_engine):
-    """The engine-level belt-and-braces: a continuation (start > 0) pack on
-    a replica-partitioned pool raises instead of silently gathering."""
+def test_dp2_ctx_pack_runs_replica_local(dp2_engine):
+    """The engine-level half: a continuation (start > 0) pack on a
+    replica-partitioned pool dispatches through the shard_map'd ctx
+    attention (no NotImplementedError, KV stays block-affine)."""
     eng = dp2_engine
     seq = eng.mgr.admit(7, [3] * 24)
     eng.mgr.ensure_capacity(seq, 0)
-    seq.seen_tokens = 8  # pretend the first page prefilled in a prior chunk
-    with pytest.raises(NotImplementedError, match="replica-local"):
-        eng.prefill_entries([(seq, 8, 24)],
-                            SamplingParams(temperature=0.0))
+    eng.prefill_entries([(seq, 0, 8)], SamplingParams(temperature=0.0))
+    out = eng.prefill_entries([(seq, 8, 24)], SamplingParams(temperature=0.0))
+    assert seq.uid in out and out[seq.uid] >= 0
+    per = eng.mgr._blocks_per
+    r = eng.mgr.replica_of(seq)
+    assert all(r * per <= b < (r + 1) * per for b in seq.blocks)
     eng.mgr.release(7)
 
 
