@@ -66,6 +66,12 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "serving/handoff.py": {"extract_request", "inject_request"},
     "serving/pool.py": {"load", "queue_depth", "running", "headroom_blocks",
                         "shedding"},
+    # the socket wire: frame packing and the KV-handoff codec are pure host
+    # byte work — a device round trip here would ride EVERY cross-process
+    # message (racelint separately forbids socket I/O under any lock)
+    "serving/transport.py": {"pack_frame", "encode_handoff",
+                             "decode_handoff", "send_frame", "recv_frame"},
+    "serving/remote.py": {"begin_tick", "finish_tick", "request_view"},
     # traced model code: a host sync here is a trace-time bug by definition
     "inference/model_runner.py": {"*"},
     "inference/sampling.py": {"*"},

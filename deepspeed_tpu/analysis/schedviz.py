@@ -1058,12 +1058,127 @@ def scenario_replica_affine_admission(seed: int, n_requests: int = 6) -> None:
         assert audit["blocks_in_use"] == 0, audit
 
 
+def scenario_heartbeat_expiry_vs_route(seed: int, n_requests: int = 5) -> None:
+    """Heartbeat-lease expiry (the out-of-process death-detection path)
+    races routing, the router tick, and a prefill->decode migration: a
+    monitor task drives the REAL ``HeartbeatMonitor`` state machine
+    (watch -> missed acks -> lease expiry on a fake clock) while the
+    router submits/ticks/migrates against workers whose ``health_check``
+    consults the monitor.  Invariants: no request is ever lost at any
+    interleaving point (tracked XOR terminal), the discovered death is
+    replayed within budget onto the surviving worker, a mid-migration
+    expiry never strands the request on either side, teardown is
+    idempotent even when the worker died between health checks, and blocks
+    drain to zero."""
+    from ..inference import scheduler as sched_mod
+    from ..inference.sampling import SamplingParams
+    from ..serving.pool import PREFILL_ROLE, Worker
+    from ..serving.router import Router
+    from ..serving.transport import HeartbeatMonitor
+    from ..telemetry import Telemetry
+
+    sched = Schedule(seed, max_preemptions=32)
+    with sched.instrument():
+        tel = Telemetry(True)
+        clock_cell = [0.0]
+        mon = HeartbeatMonitor(interval_ms=10.0, lease_ms=50.0,
+                               clock=lambda: clock_cell[0])
+        workers = []
+        for i in range(3):
+            eng, _ss = _stub_scheduler(telemetry=tel)
+            role = PREFILL_ROLE if i == 0 else None
+            w = Worker(i, eng, role or "mixed")
+            mon.watch(i)
+            w.health_check = (lambda idx=i: not mon.lease_expired(idx))
+            workers.append(w)
+
+        class _StubPool:
+            def __init__(self, ws, telemetry):
+                self.workers = ws
+                self.telemetry = telemetry
+
+            @property
+            def alive(self):
+                return [w for w in self.workers if w.alive]
+
+            @property
+            def decode_workers(self):
+                return [w for w in self.alive if w.role == "mixed"]
+
+            @property
+            def prefill_workers(self):
+                return [w for w in self.alive if w.role == PREFILL_ROLE]
+
+            def prefix_hit_rate(self):
+                return 0.0
+
+            def close(self):
+                return [w.close() if w.alive else (w.close_audit or {})
+                        for w in self.workers]
+
+        router = Router(_StubPool(workers, tel),
+                        dict(disagg_threshold=6, prefill_workers=1))
+        submitted: List[int] = []
+
+        def submitter() -> None:
+            for i in range(n_requests):
+                # odd requests are long enough to route via the prefill
+                # worker and migrate at first token (the handoff path the
+                # expiry must race)
+                prompt = [1, 2, 3, 4, 5, 6, 7, 8] if i % 2 else [1, 2, 3]
+                res = router.try_submit(
+                    500 + i, prompt,
+                    SamplingParams(temperature=0.0, max_new_tokens=2))
+                if res.accepted:
+                    submitted.append(500 + i)
+                checkpoint()
+
+        def ticker() -> None:
+            for _ in range(10):
+                router.tick()
+                for uid in submitted:  # conservation: tracked XOR terminal
+                    assert (uid in router._reqs) != (uid in router._results), uid
+
+        def monitor_task() -> None:
+            # the heartbeat thread's bookkeeping, interleaved: worker 1
+            # keeps acking for a while, then goes silent past its lease
+            for _ in range(2):
+                mon.note_ack(1)
+                checkpoint()
+            for _ in range(4):
+                clock_cell[0] += 0.02  # 4 x 20ms of silence > 50ms lease
+                mon.note_miss(1)
+                checkpoint()
+            assert mon.lease_expired(1)
+
+        sched.spawn(submitter, name="submit")
+        sched.spawn(ticker, name="tick")
+        sched.spawn(monitor_task, name="heartbeat")
+        sched.run()
+
+        assert mon.lease_expired(1)  # the lease latched
+        results = router.run(wait_for=submitted, max_ticks=256)
+        for uid in submitted:
+            state, _toks = results[uid]
+            assert state in (sched_mod.FINISHED, sched_mod.FAILED,
+                             sched_mod.TIMED_OUT), (uid, state)
+        assert not workers[1].alive  # the expiry was DISCOVERED, not injected
+        assert dict(router.stats)["discovered_deaths"] >= 1
+        for rec in router._reqs.values():
+            assert rec.replays <= router.config.max_replays
+        audits = router.close()
+        audits2 = router.close()  # idempotent after a mid-lease death
+        assert len(audits) == len(audits2)
+        assert all(a.get("blocks_in_use", 0) == 0 for a in audits), audits
+
+
 SCENARIOS = (
     scenario_namespace_claims,
     scenario_submit_tick_cancel,
     scenario_shed_watchdog,
     scenario_kill_vs_route,
     scenario_replica_affine_admission,
+    scenario_heartbeat_expiry_vs_route,
 )
 
 

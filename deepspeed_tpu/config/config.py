@@ -376,7 +376,20 @@ class RouterConfig:
     ``max_replays``: times a request may re-route and replay from its
     prompt after a worker death before it is failed.
     ``retry_backoff_ms``: fallback backoff when a worker rejects
-    RETRY_LATER without a ``retry_after_ms`` hint."""
+    RETRY_LATER without a ``retry_after_ms`` hint.
+
+    Out-of-process transport knobs (``serving/transport.py`` /
+    ``serving/remote.py`` — ignored by in-process pools):
+    ``heartbeat_interval_ms``/``lease_ms``: the monitor pings each worker's
+    dedicated heartbeat channel every interval; a worker silent past the
+    lease has its lease EXPIRE and is discovered dead (its requests replay
+    elsewhere).  ``rpc_deadline_ms``: absolute per-RPC budget (a backstop —
+    lease expiry aborts waits much earlier); ``rpc_max_attempts`` /
+    ``rpc_backoff_ms`` / ``rpc_backoff_max_ms``: bounded exponential
+    reconnect backoff (with deterministic jitter) on transient transport
+    failures; ``connect_timeout_ms``: per-channel dial budget;
+    ``max_frame_bytes``: oversized-frame guard on both sides of the wire
+    (KV-handoff payloads are the big frames)."""
 
     n_workers: int = 2
     prefill_workers: int = 0
@@ -387,6 +400,14 @@ class RouterConfig:
     shed_queue_depth: Optional[int] = None
     max_replays: int = 3
     retry_backoff_ms: float = 20.0
+    heartbeat_interval_ms: float = 50.0
+    lease_ms: float = 1000.0
+    rpc_deadline_ms: float = 120_000.0
+    rpc_max_attempts: int = 5
+    rpc_backoff_ms: float = 10.0
+    rpc_backoff_max_ms: float = 250.0
+    connect_timeout_ms: float = 30_000.0
+    max_frame_bytes: int = 64 * 1024 * 1024
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -420,6 +441,32 @@ class RouterConfig:
             raise ConfigError(
                 f"router.retry_backoff_ms must be >= 0, got "
                 f"{self.retry_backoff_ms}")
+        if self.heartbeat_interval_ms <= 0:
+            raise ConfigError(
+                f"router.heartbeat_interval_ms must be > 0, got "
+                f"{self.heartbeat_interval_ms}")
+        if self.lease_ms <= self.heartbeat_interval_ms:
+            raise ConfigError(
+                f"router.lease_ms ({self.lease_ms}) must exceed "
+                f"heartbeat_interval_ms ({self.heartbeat_interval_ms}) — a "
+                "lease shorter than one ping interval expires every healthy "
+                "worker")
+        if self.rpc_deadline_ms <= 0 or self.connect_timeout_ms <= 0:
+            raise ConfigError(
+                "router.rpc_deadline_ms and connect_timeout_ms must be > 0")
+        if self.rpc_max_attempts < 1:
+            raise ConfigError(
+                f"router.rpc_max_attempts must be >= 1, got "
+                f"{self.rpc_max_attempts}")
+        if self.rpc_backoff_ms < 0 or self.rpc_backoff_max_ms < self.rpc_backoff_ms:
+            raise ConfigError(
+                "router rpc backoff must satisfy 0 <= rpc_backoff_ms <= "
+                f"rpc_backoff_max_ms, got {self.rpc_backoff_ms}/"
+                f"{self.rpc_backoff_max_ms}")
+        if self.max_frame_bytes < 4096:
+            raise ConfigError(
+                f"router.max_frame_bytes must be >= 4096, got "
+                f"{self.max_frame_bytes}")
 
 
 @dataclass

@@ -54,9 +54,26 @@ CHECKPOINT_CRASH = "checkpoint_crash"
 # indices, not request uids); the router must re-route and replay every
 # request the dead worker held
 WORKER_KILL = "worker_kill"
+# network-scoped points (serving/transport.py consults them per frame with
+# uids=(worker_index,) — the chaos surface of the out-of-process serve
+# plane).  ``conn_drop`` severs the connection mid-stream (the peer sees a
+# torn frame / EOF), ``conn_delay`` stalls a send by ``delay_s`` (a slow
+# link; fires through the ``delay()`` API), ``partial_write`` ships only a
+# frame prefix then drops the connection (the peer reads a torn frame),
+# ``partition`` black-holes BOTH directions of every channel to that
+# worker for ``delay_s`` seconds (I/O times out, the connection stays
+# "up"), and ``heartbeat_loss`` swallows heartbeat acks so the router's
+# lease expires against a live worker.
+CONN_DROP = "conn_drop"
+CONN_DELAY = "conn_delay"
+PARTIAL_WRITE = "partial_write"
+PARTITION = "partition"
+HEARTBEAT_LOSS = "heartbeat_loss"
+NETWORK_POINTS = (CONN_DROP, CONN_DELAY, PARTIAL_WRITE, PARTITION,
+                  HEARTBEAT_LOSS)
 
 POINTS = (ALLOC_EXHAUSTION, RUNNER_EXCEPTION, NAN_LOGITS, SLOW_TICK,
-          CHECKPOINT_CRASH, WORKER_KILL)
+          CHECKPOINT_CRASH, WORKER_KILL) + NETWORK_POINTS
 
 
 class InjectedFault(RuntimeError):

@@ -3,9 +3,11 @@
 The layer above ``inference/`` — ``pool.py`` stamps out workers from one
 ``ServeEngineConfig`` (per-worker telemetry namespaces, leak-audited
 teardown), ``router.py`` owns the client-facing lifecycle (prefix-affinity
-routing, SLO-aware admission, worker-death replay), and ``handoff.py`` is
-the paged-KV wire for prefill/decode disaggregation (optionally int8 via
-qcomm's payload codec).
+routing, SLO-aware admission, worker-death replay), ``handoff.py`` is the
+paged-KV wire for prefill/decode disaggregation (optionally int8 via
+qcomm's payload codec), ``transport.py`` is the fault-tolerant socket RPC
+(framing, exactly-once retries, heartbeat health checks, network chaos),
+and ``remote.py`` spawns real worker subprocesses behind it.
 """
 from .handoff import KVHandoff, extract_request, inject_request  # noqa: F401
 from .pool import (  # noqa: F401
@@ -15,4 +17,22 @@ from .pool import (  # noqa: F401
     WorkerPool,
     serve_worker_main,
 )
+from .remote import (  # noqa: F401
+    RemotePool,
+    RemoteWorker,
+    build_remote_router,
+    spawn_worker,
+    worker_launch_cmd,
+)
 from .router import Router, RouterRequest, build_router  # noqa: F401
+from .transport import (  # noqa: F401
+    ConnectionLost,
+    FrameStream,
+    HeartbeatMonitor,
+    ProtocolError,
+    RpcClient,
+    RpcTimeout,
+    TransportError,
+    WorkerDead,
+    WorkerServer,
+)

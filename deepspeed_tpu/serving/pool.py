@@ -3,32 +3,39 @@
 The bottom half of the serve front end (``serving/router.py`` is the top):
 each :class:`Worker` wraps an ``InferenceEngineV2`` built through the
 canonical ``build_serve_engine`` seam plus its ``ServeScheduler``, and
-exposes exactly the signals the router's placement policy consumes — queue
-depth, running count, pool headroom, shed state, TTFT/TBT percentiles.
-All workers share one ``Telemetry``: the claim-prefix machinery hands each
-engine its own ``serve``/``serve2``/... namespace, so per-worker stats
-never alias and ``engine.close()`` returns the namespace on teardown.
+exposes the uniform worker interface the router drives — admission
+(``try_submit``), the tick pair (``begin_tick``/``finish_tick``), request
+views and terminal pops, the KV-handoff ops, and the load-signal surface
+(queue depth, running count, pool headroom, shed state, TTFT median).
+``serving/remote.py RemoteWorker`` implements the SAME interface over the
+socket transport, so the router is deployment-agnostic: in-process pools
+for tests and single-host serving, subprocess pools for the real thing.
 
-In-process multi-engine is the first deployment shape (the leak-audited
-``engine.close()`` path makes back-to-back and side-by-side engines safe);
-the two-process ``DSTPU_*`` bootstrap (tests/test_multiprocess_bootstrap)
-is the cross-process seam a networked pool grows from —
-:func:`serve_worker_main` is the minimal line-protocol worker loop that
-test drives over a pipe.
+All in-process workers share one ``Telemetry``: the claim-prefix machinery
+hands each engine its own ``serve``/``serve2``/... namespace, so per-worker
+stats never alias and ``engine.close()`` returns the namespace on teardown.
+
+:func:`serve_worker_main` is the cross-process stdio worker — it speaks the
+FRAMED protocol (``serving/transport.py``: length prefix + version
+handshake + payload checksum) over a binary pipe; a torn, oversized, or
+junk frame gets a typed protocol-error frame back and a clean audited
+shutdown, never an unhandled exception.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..inference.engine_v2 import build_serve_engine
+from ..inference.sampling import SamplingParams
 from ..telemetry import Telemetry
+from . import handoff as handoff_mod
 
 PREFILL_ROLE = "prefill"
 MIXED_ROLE = "mixed"
 
 
 class Worker:
-    """One engine + scheduler pair with the router-facing load surface."""
+    """One engine + scheduler pair with the router-facing worker surface."""
 
     def __init__(self, index: int, engine, role: str = MIXED_ROLE):
         if role not in (PREFILL_ROLE, MIXED_ROLE):
@@ -41,6 +48,9 @@ class Worker:
         # a RETRY_LATER rejection's retry_after_ms hint)
         self.backoff_until = 0.0
         self.close_audit: Optional[Dict[str, int]] = None
+        # optional external liveness oracle (a heartbeat lease in the
+        # remote deployment; schedviz scenarios drive it directly)
+        self.health_check = None
 
     @property
     def scheduler(self):
@@ -50,6 +60,17 @@ class Worker:
     def ns(self) -> str:
         """This worker's telemetry namespace (``serve``, ``serve2``, ...)."""
         return self.engine._ns
+
+    # -- engine geometry (the router's placement inputs) ---------------------
+    @property
+    def block_size(self) -> int:
+        return self.engine.block_size
+
+    @property
+    def disagg_default(self) -> int:
+        """Default disaggregation threshold when the router config leaves
+        it None: one prefill chunk (or the whole budget)."""
+        return int(self.engine.prefill_chunk or self.engine.prefill_budget)
 
     # -- load signals (the router's placement cost) --------------------------
     @property
@@ -85,6 +106,94 @@ class Worker:
             return float(h.percentile(50))
         except Exception:
             return 0.0
+
+    @property
+    def prompt_tokens_total(self) -> int:
+        return self.engine.mgr.prompt_tokens_total
+
+    @property
+    def cached_prompt_tokens(self) -> int:
+        return self.engine.mgr.cached_prompt_tokens
+
+    # -- liveness ------------------------------------------------------------
+    def healthy(self) -> bool:
+        """The router's per-tick death probe.  In-process workers die only
+        through the chaos ``worker_kill`` path unless an external
+        ``health_check`` oracle (heartbeat lease) says otherwise."""
+        return self.alive and (self.health_check is None
+                               or bool(self.health_check()))
+
+    # -- the op surface the router drives ------------------------------------
+    def try_submit(self, uid: int, tokens: Sequence[int],
+                   sampling: SamplingParams,
+                   deadline_ms: Optional[float] = None,
+                   ttft_deadline_ms: Optional[float] = None):
+        return self.scheduler.try_submit(
+            uid, tokens, sampling, deadline_ms=deadline_ms,
+            ttft_deadline_ms=ttft_deadline_ms)
+
+    def begin_tick(self) -> None:
+        """In-process: the tick runs synchronously here.  (The remote
+        worker posts the RPC and collects it in ``finish_tick`` so N
+        workers' forwards overlap across processes.)"""
+        self.scheduler.tick()
+
+    def finish_tick(self) -> None:
+        pass
+
+    def tick(self) -> None:
+        self.begin_tick()
+        self.finish_tick()
+
+    def request_view(self, uid: int):
+        """The live request record (state/error/generated/cancel_requested)
+        or None."""
+        return self.scheduler.requests.get(uid)
+
+    def pop_result(self, uid: int) -> List[int]:
+        return self.scheduler.pop_result(uid)
+
+    def pop_state(self, uid: int) -> Optional[Tuple[str, Optional[str],
+                                                    List[int]]]:
+        """(terminal state, error, tokens), popped — one atomic collection
+        step for the router."""
+        req = self.scheduler.requests.get(uid)
+        if req is None:
+            return None
+        state, error = req.state, req.error
+        return state, error, self.scheduler.pop_result(uid)
+
+    def cancel(self, uid: int) -> bool:
+        return self.scheduler.cancel(uid)
+
+    def retry_after_ms(self) -> float:
+        return self.scheduler.retry_after_ms()
+
+    # -- the KV-handoff surface ----------------------------------------------
+    def extract_handoff(self, uid: int, fmt: str) -> handoff_mod.KVHandoff:
+        return handoff_mod.extract_request(self.engine, uid, fmt=fmt)
+
+    def adopt_handoff(self, ho: handoff_mod.KVHandoff,
+                      sampling: SamplingParams,
+                      deadline_ms: Optional[float] = None,
+                      ttft_deadline_ms: Optional[float] = None):
+        """Adopt + inject in one step (the remote worker does both inside
+        one exactly-once RPC; the in-process path mirrors it)."""
+        res = self.scheduler.adopt_prefilled(
+            ho.uid, ho.tokens, n_ctx=ho.n_ctx, sampling=sampling,
+            deadline_ms=deadline_ms, ttft_deadline_ms=ttft_deadline_ms)
+        if res.accepted:
+            handoff_mod.inject_request(self.engine, ho)
+        return res
+
+    def detach_migrated(self, uid: int) -> bool:
+        """MIGRATED release + pop on the source after a successful handoff;
+        False when a deferred cancel won the race (the caller must then
+        cancel the adopted copy)."""
+        if self.scheduler.detach(uid):
+            self.scheduler.pop_result(uid)
+            return True
+        return False
 
     # -- lifecycle -----------------------------------------------------------
     def kill(self) -> None:
@@ -148,8 +257,8 @@ class WorkerPool:
         """Aggregate prompt prefix-cache hit rate across all workers (the
         front end's headline: replica scale WITHOUT forfeiting the shared-
         prefix wins the 2-D mesh gates off)."""
-        total = sum(w.engine.mgr.prompt_tokens_total for w in self.workers)
-        cached = sum(w.engine.mgr.cached_prompt_tokens for w in self.workers)
+        total = sum(w.prompt_tokens_total for w in self.workers)
+        cached = sum(w.cached_prompt_tokens for w in self.workers)
         return cached / total if total else 0.0
 
     def close(self) -> List[Dict[str, int]]:
@@ -162,65 +271,34 @@ class WorkerPool:
 
 def serve_worker_main(stdin=None, stdout=None, params=None, cfg=None,
                       sec=None, serve=None) -> None:
-    """Minimal cross-process worker loop: one JSON request per line on
-    ``stdin`` -> one JSON reply per line on ``stdout``.  The process-level
-    seam the two-process router smoke drives — the engine bootstraps through
-    ``comm.init_distributed`` (the ``DSTPU_*`` env protocol) exactly like a
-    launcher-spawned serve process, then serves ``submit`` requests through
-    the same scheduler path the in-process pool uses.
+    """Cross-process stdio worker: the FRAMED protocol over a binary pipe.
 
-    Protocol (newline-delimited JSON):
-      ``{"op": "submit", "uid": int, "tokens": [...], "max_new_tokens": n}``
-        -> ``{"uid": ..., "state": ..., "tokens": [...]}``
-      ``{"op": "stats"}`` -> the worker's serve/sched stats dicts
-      ``{"op": "close"}`` -> ``{"audit": {...}}`` and the loop exits
+    The process-level seam the two-process router tests drive — the engine
+    bootstraps through ``comm.init_distributed`` (the ``DSTPU_*`` env
+    protocol) exactly like a launcher-spawned serve process, then serves
+    the same RPC op set the socket workers speak
+    (``transport.WorkerServer``: handshake, ``submit``/``tick``/``pop``/
+    ``cancel``/``extract``/``adopt``/``detach``/``stats``/``close``), with
+    the stdio hardening contract: any torn, oversized, or junk frame is
+    answered with a typed protocol-error frame (where the pipe still
+    writes) followed by a clean audited shutdown — never an unhandled
+    exception, never a zombie engine.
+
+    ``stdin``/``stdout`` must be BINARY streams; None uses this process's
+    ``sys.std{in,out}.buffer`` (the ``readiness``/result prints of older
+    line-protocol workers are gone — every byte on the pipe is a frame).
     """
-    import json
     import sys
 
     from ..comm.comm import init_distributed
-    from ..inference.sampling import SamplingParams
+    from .transport import FrameStream, WorkerServer
 
     init_distributed()  # DSTPU_* env (single process: a no-op bootstrap)
-    stdin = stdin or sys.stdin
-    stdout = stdout or sys.stdout
+    stdin = stdin if stdin is not None else sys.stdin.buffer
+    stdout = stdout if stdout is not None else sys.stdout.buffer
     engine = build_serve_engine(params, cfg, sec, serve=serve)
-    sched = engine.scheduler
-    for line in stdin:
-        line = line.strip()
-        if not line:
-            continue
-        msg = json.loads(line)
-        op = msg.get("op")
-        if op == "close":
-            audit = engine.close()
-            print(json.dumps({"audit": audit}), file=stdout, flush=True)
-            break
-        if op == "stats":
-            print(json.dumps({"serve": dict(engine.stats),
-                              "sched": dict(sched.stats)}),
-                  file=stdout, flush=True)
-            continue
-        if op == "submit":
-            uid = int(msg["uid"])
-            samp = SamplingParams(
-                temperature=float(msg.get("temperature", 0.0)),
-                max_new_tokens=int(msg.get("max_new_tokens", 16)),
-            )
-            res = sched.try_submit(uid, msg["tokens"], samp)
-            if not res.accepted:
-                print(json.dumps({"uid": uid, "state": "rejected",
-                                  "reason": res.reason}),
-                      file=stdout, flush=True)
-                continue
-            sched.run(wait_for=[uid])
-            state = sched.requests[uid].state
-            toks = sched.pop_result(uid)
-            print(json.dumps({"uid": uid, "state": state, "tokens": toks}),
-                  file=stdout, flush=True)
-            continue
-        print(json.dumps({"error": f"unknown op {op!r}"}),
-              file=stdout, flush=True)
+    server = WorkerServer(engine)
+    server.serve_stream(FrameStream(rfile=stdin, wfile=stdout))
 
 
 __all__: List[Any] = [

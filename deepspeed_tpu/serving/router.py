@@ -22,10 +22,22 @@ compose:
   (``serving/handoff.py`` — payload optionally int8 on the wire), so a 32k
   prompt never stalls a decode worker's tick.
 * **SLO-aware admission** — worker ``RETRY_LATER`` rejections back that
-  worker off for its ``retry_after_ms`` hint and re-route; the router's own
-  backlog depth sheds at the front door with the same typed rejection
-  before any worker saturates; worker death re-routes and replays every
-  lost request from its prompt (token-identical for greedy decode).
+  worker off for its ``retry_after_ms`` hint and re-route (the hint rides
+  the socket wire unchanged for remote workers); the router's own backlog
+  depth sheds at the front door with the same typed rejection before any
+  worker saturates; worker death re-routes and replays every lost request
+  from its prompt (token-identical for greedy decode).
+
+The router drives a deployment-agnostic worker interface: in-process
+``pool.Worker`` objects, or ``remote.RemoteWorker`` facades over the
+fault-tolerant socket transport (``serving/transport.py``).  Death is
+*discovered*, not just injected: each tick probes ``worker.healthy()`` —
+backed by the heartbeat lease for remote workers — and a worker found dead
+(or partitioned) has its in-flight requests replayed from their prompts
+under the ``max_replays`` budget.  The degradation ladder: full pool →
+per-worker backoff (``retry_after_ms``) → router backlog → front-door shed
+→ death replay onto the surviving worker set → a loud typed refusal (never
+a hang) at zero live workers.
 
 Single-threaded by design, like the engine tick loop: ``tick()`` drives
 every live worker once and the router's control work happens between
@@ -52,8 +64,7 @@ from ..inference.scheduler import (
     SubmitResult,
 )
 from ..telemetry import StatsView
-from . import handoff as handoff_mod
-from .pool import MIXED_ROLE, PREFILL_ROLE, WorkerPool
+from .pool import MIXED_ROLE, WorkerPool
 
 BACKLOG, SUBMITTED, DONE = "backlog", "submitted", "done"
 
@@ -90,18 +101,19 @@ class Router:
         self.faults = faults
         self.telemetry = pool.telemetry
         self._clock = self.telemetry.clock
-        eng0 = pool.workers[0].engine
-        self._block_size = eng0.block_size
+        w0 = pool.workers[0]
+        self._block_size = w0.block_size
         self._disagg_threshold = (
             self.config.disagg_threshold
             if self.config.disagg_threshold is not None
-            else (eng0.prefill_chunk or eng0.prefill_budget)
+            else w0.disagg_default
         )
         self._ns = self.telemetry.claim_prefix("router")
         self._c = self.telemetry.counters(self._ns, (
             "submitted",
             "rejected",  # CLIENT_ERRORS surfaced to the caller
             "shed_rejections",  # front-door RETRY_LATER (router backlog)
+            "no_worker_refusals",  # typed refusals with ZERO live workers
             "routed_affinity",  # placements won by the prefix-chain map
             "routed_least_loaded",
             "routed_prefill",  # long prompts placed on PREFILL-role workers
@@ -110,6 +122,7 @@ class Router:
             "handoff_wire_bytes",  # payload+scales bytes across all handoffs
             "handoff_fallbacks",  # migrations that stayed put (no room)
             "worker_deaths",
+            "discovered_deaths",  # deaths found by health probe/lease expiry
             "replays",  # requests re-routed + replayed from the prompt
             "finished", "failed", "timed_out", "cancelled",
         ))
@@ -209,7 +222,7 @@ class Router:
         the next candidate."""
         hints: List[float] = []
         for w, kind in self._candidates(rec):
-            res = w.scheduler.try_submit(
+            res = w.try_submit(
                 rec.uid, rec.prompt, rec.sampling,
                 deadline_ms=self._remaining_deadline(rec),
                 ttft_deadline_ms=rec.ttft_deadline_ms,
@@ -262,10 +275,17 @@ class Router:
                                 f"uid {uid} already in use")
         if not tokens:
             return SubmitResult(uid, REJECT_EMPTY_PROMPT, "empty prompt")
+        if not self.pool.alive:
+            # the bottom of the degradation ladder: a loud typed refusal,
+            # never a silent backlog nothing will ever drain
+            self._c["no_worker_refusals"].inc()
+            return SubmitResult(
+                uid, RETRY_LATER, "no live workers in the pool",
+                retry_after_ms=self.config.retry_backoff_ms)
         depth = self.config.shed_queue_depth
         if depth is not None and len(self._backlog) >= depth:
             self._c["shed_rejections"].inc()
-            hints = [w.scheduler.retry_after_ms()
+            hints = [w.retry_after_ms()
                      for w in self.pool.alive] or [
                          self.config.retry_backoff_ms]
             return SubmitResult(
@@ -307,8 +327,8 @@ class Router:
             return False
         if rec.phase == SUBMITTED:
             w = self.pool.workers[rec.worker]
-            if w.alive and w.scheduler.cancel(uid):
-                w.scheduler.pop_result(uid)
+            if w.alive and w.cancel(uid):
+                w.pop_result(uid)
         self._finish(rec, sched_mod.CANCELLED, [], None)
         return True
 
@@ -352,8 +372,13 @@ class Router:
         return not self._reqs
 
     # -- worker death --------------------------------------------------------
-    def _kill_worker(self, w) -> None:
+    def _kill_worker(self, w, discovered: bool = False) -> None:
         self._c["worker_deaths"].inc()
+        if discovered:
+            # found by the health probe (heartbeat lease expiry, transport
+            # retry exhaustion) rather than injected — the out-of-process
+            # death-detection path
+            self._c["discovered_deaths"].inc()
         lost = [r for r in self._reqs.values()
                 if r.phase == SUBMITTED and r.worker == w.index]
         w.kill()
@@ -362,52 +387,66 @@ class Router:
         for k in [k for k, v in self._affinity.items() if v == w.index]:
             del self._affinity[k]
         for rec in lost:
-            rec.worker = None
-            rec.disagg = False
-            if rec.replays >= self.config.max_replays:
-                self._finish(rec, sched_mod.FAILED, [],
-                             "worker died; replay budget exhausted")
-                continue
-            # replay from the prompt on another worker: greedy decode makes
-            # the retried result token-identical to the lost one
-            rec.replays += 1
-            self._c["replays"].inc()
-            rec.phase = BACKLOG
-            self._backlog.append(rec.uid)
+            self._replay_lost(rec)
+
+    def _replay_lost(self, rec: RouterRequest) -> None:
+        """Reclaim a request whose worker is gone: replay from the prompt on
+        another worker (greedy decode makes the retried result
+        token-identical to the lost one) under the ``max_replays`` budget,
+        then typed FAILED.  Called from ``_kill_worker`` for the requests
+        known at death time AND from the tick's collection loop — a submit
+        racing a death can land on a worker in the instant it dies, and
+        that straggler must heal the same way instead of being tracked
+        forever."""
+        rec.worker = None
+        rec.disagg = False
+        if rec.replays >= self.config.max_replays:
+            self._finish(rec, sched_mod.FAILED, [],
+                         "worker died; replay budget exhausted")
+            return
+        rec.replays += 1
+        self._c["replays"].inc()
+        rec.phase = BACKLOG
+        self._backlog.append(rec.uid)
 
     # -- prefill/decode migration -------------------------------------------
     def _maybe_migrate(self, rec: RouterRequest) -> None:
         src = self.pool.workers[rec.worker]
-        req = src.scheduler.requests.get(rec.uid)
-        if req is None or req.state != sched_mod.DECODE or not req.generated:
+        view = src.request_view(rec.uid)
+        if view is None or view.state != sched_mod.DECODE \
+                or not view.generated:
             return  # still prefilling (or already terminal — collected below)
-        if req.cancel_requested:
+        if view.cancel_requested:
             return  # deferred cancel pending: never migrate doomed work
         targets = [w for w in self.pool.decode_workers
                    if not w.shedding and w is not src]
-        seq = src.engine.mgr.seqs[rec.uid]
         ho = None
         for tgt in sorted(targets, key=self._cost):
             if ho is None:
-                ho = handoff_mod.extract_request(
-                    src.engine, rec.uid, fmt=self.config.handoff_fmt)
-            res = tgt.scheduler.adopt_prefilled(
-                rec.uid, list(seq.tokens), n_ctx=seq.seen_tokens,
-                sampling=rec.sampling,
+                try:
+                    ho = src.extract_handoff(rec.uid,
+                                             fmt=self.config.handoff_fmt)
+                except Exception:
+                    # source died/stalled mid-extract (network): the request
+                    # keeps decoding where it is; the health probe owns the
+                    # death path
+                    rec.disagg = False
+                    self._c["handoff_fallbacks"].inc()
+                    return
+            res = tgt.adopt_handoff(
+                ho, sampling=rec.sampling,
                 deadline_ms=self._remaining_deadline(rec),
                 ttft_deadline_ms=rec.ttft_deadline_ms,
             )
             if res.accepted:
-                handoff_mod.inject_request(tgt.engine, ho)
-                if not src.scheduler.detach(rec.uid):
+                if not src.detach_migrated(rec.uid):
                     # the source refused (a deferred cancel won the race
                     # and released CANCELLED): kill the adopted copy and
                     # let terminal collection pick the cancel up from src
-                    tgt.scheduler.cancel(rec.uid)
-                    tgt.scheduler.pop_result(rec.uid)
+                    tgt.cancel(rec.uid)
+                    tgt.pop_result(rec.uid)
                     rec.disagg = False
                     return
-                src.scheduler.pop_result(rec.uid)
                 rec.worker = tgt.index
                 rec.disagg = False
                 self._c["handoffs"].inc()
@@ -428,10 +467,16 @@ class Router:
 
     # -- the loop ------------------------------------------------------------
     def tick(self) -> None:
-        """One front-end tick: (chaos) worker-kill checks -> one scheduler
-        tick per live worker -> first-token migrations -> terminal
-        collection -> backlog re-route + front-door deadline expiry."""
+        """One front-end tick: death checks (injected worker-kill chaos AND
+        the ``healthy()`` probe — heartbeat-lease expiry / transport retry
+        exhaustion for remote workers) -> one scheduler tick per live
+        worker (pipelined: remote ticks overlap across processes) ->
+        first-token migrations -> terminal collection -> backlog re-route +
+        front-door deadline expiry.  At zero live workers every tracked
+        request fails LOUDLY typed — the router never hangs on an empty
+        pool."""
         self.tick_no += 1
+        ticked = []
         for w in list(self.pool.alive):
             if self.faults is not None:
                 try:
@@ -439,7 +484,18 @@ class Router:
                 except InjectedFault:
                     self._kill_worker(w)
                     continue
-            w.scheduler.tick()
+            if not w.healthy():
+                self._kill_worker(w, discovered=True)
+                continue
+            w.begin_tick()
+            ticked.append(w)
+        for w in ticked:
+            w.finish_tick()
+        if not self.pool.alive:
+            for rec in list(self._reqs.values()):
+                self._finish(rec, sched_mod.FAILED, [],
+                             "no live workers in the pool")
+            return
         # first-token migrations off prefill-role workers
         for rec in [r for r in list(self._reqs.values())
                     if r.phase == SUBMITTED and r.disagg]:
@@ -450,13 +506,19 @@ class Router:
                     if r.phase == SUBMITTED]:
             w = self.pool.workers[rec.worker]
             if not w.alive:
-                continue  # killed this tick; _kill_worker handled its loss
-            req = w.scheduler.requests.get(rec.uid)
-            if req is None or req.state not in sched_mod.TERMINAL:
+                # usually _kill_worker already replayed this worker's loss
+                # (re-phasing its requests to BACKLOG) — anything still
+                # SUBMITTED here slipped in racing the death and must heal
+                # through the same replay path, never be tracked forever
+                self._replay_lost(rec)
                 continue
-            state = req.state
-            error = req.error
-            tokens = w.scheduler.pop_result(rec.uid)
+            view = w.request_view(rec.uid)
+            if view is None or view.state not in sched_mod.TERMINAL:
+                continue
+            popped = w.pop_state(rec.uid)
+            if popped is None:
+                continue  # worker died between view and pop: replay next tick
+            state, error, tokens = popped
             self._finish(rec, state, tokens, error)
         # re-route the backlog (deadline-expire what cannot wait)
         for uid in list(self._backlog):

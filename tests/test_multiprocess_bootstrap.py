@@ -94,25 +94,39 @@ serve_worker_main(
 """
 
 
-@pytest.mark.nightly  # spawns a fresh jax worker process (~30 s)
-def test_two_process_router_worker_round_trip():
-    """Router process + worker process over the ``DSTPU_*`` env protocol:
-    the worker bootstraps through ``comm.init_distributed`` (the same env
-    seam the launcher/runners emit — a real ``jax.distributed.initialize``
-    with a live coordinator), serves the ``serve_worker_main`` line
-    protocol, and one request round-trips token-identically to an in-proc
-    reference engine.  This test's own process plays the router side of the
-    pipe — the cross-process seam the in-proc ``serving.WorkerPool`` grows
-    from."""
-    import json
+def _reference_tokens(prompt, max_new):
+    """Greedy tokens from an in-proc reference engine (same seed 0 fp32
+    init on the same platform -> bit-identical params)."""
+    import jax
+    import jax.numpy as jnp
 
     from deepspeed_tpu.inference.engine_v2 import build_serve_engine
     from deepspeed_tpu.inference.sampling import SamplingParams
     from deepspeed_tpu.models import get_preset
     from deepspeed_tpu.models.transformer import init_params
 
-    import jax
-    import jax.numpy as jnp
+    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    ref = build_serve_engine(params, cfg, dict(
+        max_seqs=2, num_blocks=32, block_size=8, prefill_buckets=[16, 32]))
+    want = ref.generate(prompt, SamplingParams(temperature=0.0,
+                                               max_new_tokens=max_new))
+    ref.close()
+    return want
+
+
+@pytest.mark.nightly  # spawns a fresh jax worker process (~30 s)
+def test_two_process_router_worker_round_trip():
+    """Router process + worker process over the ``DSTPU_*`` env protocol:
+    the worker bootstraps through ``comm.init_distributed`` (the same env
+    seam the launcher/runners emit — a real ``jax.distributed.initialize``
+    with a live coordinator), serves the FRAMED stdio protocol
+    (``serving/transport.py``: length prefix + version handshake + payload
+    checksum), and one request round-trips token-identically to an in-proc
+    reference engine.  This test's own process plays the router side of
+    the pipe with a real ``FrameStream``."""
+    from deepspeed_tpu.serving.transport import (
+        FT_RESPONSE, FrameStream, client_handshake)
 
     port = 9231 + (os.getpid() % 500)
     env = dict(os.environ)
@@ -126,35 +140,109 @@ def test_two_process_router_worker_round_trip():
     proc = subprocess.Popen(
         [sys.executable, "-c", _ROUTER_WORKER], env=env,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, text=True,
+        stderr=subprocess.PIPE,  # binary pipes: every byte is a frame
     )
     try:
-        req = {"op": "submit", "uid": 1, "tokens": prompt,
-               "max_new_tokens": 6, "temperature": 0.0}
-        proc.stdin.write(json.dumps(req) + "\n")
-        proc.stdin.write(json.dumps({"op": "close"}) + "\n")
-        proc.stdin.flush()
-        out, err = proc.communicate(timeout=240)
+        stream = FrameStream(rfile=proc.stdout, wfile=proc.stdin)
+        identity = client_handshake(stream, "rpc", timeout=180.0)
+        assert identity["block_size"] == 8, identity
+
+        def call(rid, op):
+            stream.send_json(3, rid, op)  # FT_REQUEST
+            f = stream.recv_frame(timeout=180.0)
+            assert f.ftype == FT_RESPONSE and f.rid == rid, (f.name, f.rid)
+            return f.json()
+
+        reply = call(1, {"op": "submit", "uid": 1, "tokens": prompt,
+                         "sampling": {"temperature": 0.0,
+                                      "max_new_tokens": 6}})
+        assert reply["ok"] and reply["result"]["reason"] == "queued", reply
+        rid = 2
+        for _ in range(64):
+            reply = call(rid, {"op": "tick"})
+            rid += 1
+            if reply["requests"].get("1", {}).get("state") == "finished":
+                break
+        assert reply["requests"]["1"]["state"] == "finished", reply
+        popped = call(rid, {"op": "pop", "uid": 1})
+        closed = call(rid + 1, {"op": "close"})
+        proc.stdin.close()
+        proc.wait(timeout=60)
     except Exception:
         proc.kill()
+        proc.wait()
         raise
-    assert proc.returncode == 0, f"worker failed:\n{out}\n{err[-2000:]}"
-    lines = [json.loads(l) for l in out.splitlines() if l.strip()]
-    reply = lines[0]
-    assert reply["state"] == "finished", reply
+    finally:
+        err = proc.stderr.read().decode(errors="replace") if proc.stderr else ""
+        for s in (proc.stdout, proc.stderr):
+            if s is not None:
+                s.close()
+    assert proc.returncode == 0, f"worker failed:\n{err[-2000:]}"
     # zero-leak audit from the worker's engine.close()
-    assert lines[1]["audit"]["blocks_in_use"] == 0, lines[1]
+    assert closed["audit"]["blocks_in_use"] == 0, closed
+    want = _reference_tokens(prompt, 6)
+    assert popped["result"]["tokens"] == want, (popped, want)
 
-    # greedy token identity vs an in-proc reference engine (same seed 0
-    # fp32 init on the same platform -> bit-identical params)
-    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
-    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
-    ref = build_serve_engine(params, cfg, dict(
-        max_seqs=2, num_blocks=32, block_size=8, prefill_buckets=[16, 32]))
-    want = ref.generate(prompt, SamplingParams(temperature=0.0,
-                                               max_new_tokens=6))
-    ref.close()
-    assert reply["tokens"] == want, (reply["tokens"], want)
+
+@pytest.mark.nightly  # spawns two fresh jax worker processes (~60 s)
+def test_two_process_socket_round_trip_and_reap():
+    """The full out-of-process spawn path: ``spawn_worker`` launches real
+    worker subprocesses serving the SOCKET protocol, a ``RemoteWorker``
+    (RPC client + heartbeat lease) drives one request to completion
+    token-identically to the in-proc reference, teardown audits zero-leak
+    — and every child is REAPED (no zombies), idempotently, including a
+    worker hard-killed between health checks."""
+    from deepspeed_tpu.config.config import RouterConfig
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.serving.remote import RemoteWorker, spawn_worker
+    from deepspeed_tpu.serving.transport import HeartbeatMonitor
+
+    spec = {"preset": "tiny", "seed": 0, "dtype": "float32",
+            "max_seq_len": 128, "platform": "cpu",
+            "sec": dict(max_seqs=2, num_blocks=32, block_size=8,
+                        prefill_buckets=[16, 32])}
+    env = {"JAX_PLATFORMS": "cpu"}
+    handles = [spawn_worker({**spec, "worker": i}, env=env, wait_ready=False)
+               for i in range(2)]
+    cfg = RouterConfig(heartbeat_interval_ms=50.0, lease_ms=2000.0,
+                       rpc_backoff_ms=5.0, rpc_backoff_max_ms=100.0)
+    mon = HeartbeatMonitor(interval_ms=50.0, lease_ms=2000.0)
+    workers = []
+    try:
+        for i, h in enumerate(handles):
+            h.wait_ready(240.0)
+            workers.append(RemoteWorker(i, h.host, h.port, mon, handle=h,
+                                        config=cfg))
+        mon.start()
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        w0, w1 = workers
+        res = w0.try_submit(1, prompt, SamplingParams(temperature=0.0,
+                                                      max_new_tokens=6))
+        assert res.accepted, res
+        for _ in range(64):
+            w0.tick()
+            view = w0.request_view(1)
+            if view is not None and view.state == "finished":
+                break
+        assert w0.request_view(1).state == "finished"
+        state, error, tokens = w0.pop_state(1)
+        assert state == "finished" and error is None
+        assert tokens == _reference_tokens(prompt, 6), tokens
+        # graceful close: audited zero-leak teardown in the worker process
+        audit = w0.close()
+        assert audit is not None and audit["blocks_in_use"] == 0, audit
+        assert handles[0].proc.poll() is not None  # reaped, no zombie
+        # hard-kill the second worker (death between health checks), then
+        # tear down through BOTH paths — idempotent, still no zombie
+        handles[1].kill_process()
+        w1.kill()
+        w1.kill()
+        assert w1.close() is None  # audit died with the process
+        assert handles[1].proc.poll() is not None
+    finally:
+        mon.stop()
+        for h in handles:
+            h.reap()
 
 
 @pytest.mark.nightly  # spawns two fresh jax processes (~30 s)

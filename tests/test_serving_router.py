@@ -469,3 +469,35 @@ def test_bench_serving_router_smoke(capsys):
     assert extra["kv_handoff"]["int8_wire_saving"] > 0.5
     assert extra["allocator_leak_check"] == "pass"
     assert len(set(extra["worker_namespaces"])) == 2
+
+
+@pytest.mark.nightly  # spawns 7 jax worker subprocesses (~3 min)
+def test_bench_router_chaos_oop_gates(capsys):
+    """The full `--serving --router --chaos --smoke` path including the
+    OUT-OF-PROCESS half: KV handoff over the socket wire (both formats,
+    byte-exact accounting vs in-proc) and the seeded network storm over
+    real worker subprocesses — availability >= the in-proc router
+    baseline, one REAL process kill discovered via heartbeat lease,
+    replays token-identical, surviving workers audited zero-leak."""
+    import importlib.util
+    import json
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.router_serve_main(smoke=True, chaos=True)
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    oop = json.loads(line)["extra"]["chaos"]["oop"]
+    if "skipped" in oop:
+        pytest.skip(oop["skipped"])  # TPU box: CPU-vs-TPU greedy near-ties
+    assert oop["availability"] >= \
+        oop["in_proc_router_baseline_availability"]
+    assert oop["worker_deaths"] == 1 and oop["discovered_deaths"] == 1
+    assert oop["replays"] > 0 and oop["replayed_token_identical"] is True
+    assert oop["kv_handoff"]["none"]["matches_in_proc_accounting"] is True
+    assert oop["kv_handoff"]["int8"]["matches_in_proc_accounting"] is True
+    assert oop["surviving_worker_audits"] == "pass"
+    assert oop["conn_drops_fired"] > 0 and oop["partitions_fired"] == 1
