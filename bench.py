@@ -1103,6 +1103,129 @@ def serving_main(quant=None, spec=False, smoke=False):
     }))
 
 
+def megastep_serve_main(smoke: bool = False, quant=None, megastep=None):
+    """Megastep decode A/B twin (`python bench.py --serving --megastep
+    [--smoke] [--quant int8]`): the SAME shared-prefix arrival workload
+    served twice through the ServeScheduler — per-tick decode
+    (``decode_megastep=1``, the PR 15 baseline) vs megastep decode
+    (``decode_megastep=N``: up to N decode-only ticks fused into ONE
+    device-resident burst with on-device stop detection, one host sync at
+    the burst boundary).  Prints one JSON line with both runs' TBT p50 and
+    host-syncs-per-token (the number the megastep exists to move) and
+    asserts the fused run is greedy TOKEN-IDENTICAL to the per-tick run.
+    Returns the payload (the tier-1 in-proc smoke gate calls this
+    directly)."""
+    from deepspeed_tpu.config.config import ServeConfig
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.telemetry import (format_percentile_table,
+                                         percentile_summary)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and not smoke:
+        cfg = get_preset("llama3_proxy_410m")
+        dtype = jnp.bfloat16
+        n_req, sys_len, sfx_len, max_new = 16, 512, 64, 48
+        ekw = dict(max_seqs=8, num_blocks=256, block_size=32,
+                   max_seq_len=704, prefill_buckets=(64, 128, 256),
+                   prefill_budget=256, prefill_chunk=256)
+        n_fuse = int(megastep or 8)
+        check_identity = False  # bf16 near-ties may flip greedy argmax
+    else:  # CPU smoke (the CI fast lane): fp32 so identity is exact
+        cfg = get_preset("tiny", max_seq_len=512, dtype=jnp.float32)
+        dtype = jnp.float32
+        n_req, sys_len, sfx_len, max_new = 6, 48, 8, 12
+        ekw = dict(max_seqs=4, num_blocks=48, block_size=8,
+                   max_seq_len=128, prefill_buckets=(16, 32, 64),
+                   prefill_budget=64, prefill_chunk=32)
+        n_fuse = int(megastep or 4)
+        check_identity = True
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=dtype)
+    samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
+
+    def run_once(fuse: int):
+        """One full arrival run on a fresh engine (fresh numpy rng, seeded
+        engine PRNG), telemetry on for the TBT table.  Identical workload
+        both ways — only ``decode_megastep`` differs."""
+        rng = np.random.default_rng(0)
+        sys_prompt = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+        prompts = {
+            u: sys_prompt + rng.integers(1, cfg.vocab_size, sfx_len).tolist()
+            for u in range(1, n_req + 1)
+        }
+        arrival_steps = rng.poisson(2.0, n_req)
+        eng = InferenceEngineV2(
+            params, cfg, enable_prefix_caching=True, telemetry=True,
+            quantize_weights=quant, serve=ServeConfig(decode_megastep=fuse),
+            **ekw,
+        )
+        sched = eng.scheduler
+        arrivals = np.cumsum(arrival_steps)
+        submitted = 0
+        t0 = time.perf_counter()
+        while submitted < n_req or not sched.idle:
+            while submitted < n_req and arrivals[submitted] <= sched.tick_no:
+                submitted += 1
+                sched.submit(submitted, prompts[submitted], samp)
+            sched.tick()
+        dt = time.perf_counter() - t0
+        results = {u: sched.pop_result(u) for u in range(1, n_req + 1)}
+        assert all(len(r) == max_new for r in results.values()), \
+            "requests failed"
+        eng.telemetry.flush()
+        pct = percentile_summary(eng.telemetry.registry,
+                                 ("serve/tbt_ms", "serve/decode_tick_ms"))
+        stats = dict(eng.stats)
+        # one host sync per decode dispatch, one per whole burst — the
+        # round-trip count the megastep amortizes
+        syncs = (stats["decode_ticks"] + stats["spec_ticks"]
+                 + stats["decode_bursts"])
+        toks = stats["decode_emitted"] + stats.get("burst_emitted", 0)
+        eng.close()
+        return dict(
+            results=results, dt=dt, pct=pct,
+            tbt_p50=pct.get("tbt_ms", {}).get("p50"),
+            syncs_per_token=syncs / max(1, toks),
+            bursts=stats["decode_bursts"], burst_ticks=stats["burst_ticks"],
+            total_tokens=(sum(len(p) for p in prompts.values())
+                          + sum(len(r) for r in results.values())),
+        )
+
+    base = run_once(1)
+    fused = run_once(n_fuse)
+    token_identical = fused["results"] == base["results"]
+    if check_identity:
+        assert token_identical, (
+            "megastep decode diverged from per-tick greedy decode")
+    assert fused["bursts"] > 0, "megastep run never fused a burst"
+    print(format_percentile_table(
+        fused["pct"], title=f"serve latency (decode_megastep={n_fuse})"))
+    payload = {
+        "metric": "serve_megastep_effective_tokens_per_sec_shared_prefix",
+        "value": round(fused["total_tokens"] / fused["dt"], 1),
+        "unit": "tokens/s",
+        "extra": {
+            "decode_megastep": n_fuse, "requests": n_req,
+            "shared_prefix": sys_len, "max_new_tokens": max_new,
+            "quantize_weights": quant,
+            "per_tick_tokens_per_sec": round(
+                base["total_tokens"] / base["dt"], 1),
+            "tbt_p50_ms_per_tick": base["tbt_p50"],
+            "tbt_p50_ms_megastep": fused["tbt_p50"],
+            "host_syncs_per_token_per_tick": round(
+                base["syncs_per_token"], 3),
+            "host_syncs_per_token_megastep": round(
+                fused["syncs_per_token"], 3),
+            "bursts": fused["bursts"], "burst_ticks": fused["burst_ticks"],
+            "greedy_token_identical": token_identical,
+        },
+    }
+    print(json.dumps(payload))
+    return payload
+
+
 def replica_serve_main(replicas: int = 2, smoke: bool = False, quant=None):
     """Replica-affine serving twin (`python bench.py --serving --replicas R
     [--smoke] [--quant int8]`): the SAME shared-prefix arrival workload
@@ -2132,7 +2255,8 @@ def audit_main(smoke: bool = False, out: str = None):
       submit/tick/cancel, shed vs watchdog, worker-kill vs route) over a
       bank of schedules; any failing seed replays exactly;
     - **serve** — compiled-program audit of every serving hot jit (decode,
-      packed prefill, ctx-pack prefill, speculative verify) on a tp=2
+      megastep decode burst, packed prefill, ctx-pack prefill,
+      speculative verify) on a tp=2
       engine in BOTH transports (passthrough and int8 + tiles): donation
       (KV/state input-output aliasing), collective wire-byte budget vs the
       shared ``comm/budget`` plan, exact payload-dtype audit, and the TP
@@ -2387,6 +2511,12 @@ if __name__ == "__main__":
         router_serve_main(smoke=smoke, chaos="--chaos" in sys.argv)
     elif "--serving" in sys.argv and "--chaos" in sys.argv:
         chaos_serve_main(smoke=smoke)
+    elif "--serving" in sys.argv and "--megastep" in sys.argv:
+        ms = None
+        i = sys.argv.index("--megastep") + 1
+        if i < len(sys.argv) and not sys.argv[i].startswith("--"):
+            ms = int(sys.argv[i])
+        megastep_serve_main(smoke=smoke, quant=q, megastep=ms)
     elif "--serving" in sys.argv and "--replicas" in sys.argv:
         r = int(sys.argv[sys.argv.index("--replicas") + 1])
         replica_serve_main(replicas=r, smoke=smoke, quant=q)

@@ -43,6 +43,10 @@ HOT_PATHS: Dict[str, Set[str]] = {
         "_run_packed_prefill", "prefill_entries", "_decode_tick",
         "_spec_tick", "step", "step_n", "_tables_device",
         "_sampling_device", "_account_comm", "_set_block_table",
+        # megastep decode (PR 16): the burst core's ONE np.asarray fetch
+        # is the whole design — any other sync inside it would re-pay the
+        # host round trip the burst exists to amortize
+        "_decode_burst",
         # the KV-handoff seam (PR 12): np.asarray is the designed host
         # copy; any OTHER sync primitive mid-migration stalls the tick
         "extract_kv_blocks", "inject_kv_blocks",
@@ -56,6 +60,11 @@ HOT_PATHS: Dict[str, Set[str]] = {
         "_adopt_prefilled_locked", "cancel", "detach", "_release",
         "_release_locked", "_admit_phase", "_try_admit_locked",
         "_expire_phase", "_preempt", "retry_after_ms", "pop_result",
+        # the megastep loop (PR 16): planning and dispatching a fused
+        # decode burst must never add a host sync — the burst's single
+        # fetch happens inside the engine's _decode_burst, nowhere else
+        "_plan_megastep", "_remaining_emit", "_decode_phase",
+        "_dispatch_decode",
     },
     # the router front end's control loop + its load-signal reads: router
     # instrumentation must never add a device round trip to a worker's tick
@@ -70,7 +79,11 @@ HOT_PATHS: Dict[str, Set[str]] = {
     # byte work — a device round trip here would ride EVERY cross-process
     # message (racelint separately forbids socket I/O under any lock)
     "serving/transport.py": {"pack_frame", "encode_handoff",
-                             "decode_handoff", "send_frame", "recv_frame"},
+                             "decode_handoff", "send_frame", "recv_frame",
+                             # the step_burst RPC path (PR 16): the burst
+                             # reply is pure host bookkeeping over the
+                             # scheduler's already-fetched state
+                             "_op_step_burst", "_request_views"},
     "serving/remote.py": {"begin_tick", "finish_tick", "request_view"},
     # traced model code: a host sync here is a trace-time bug by definition
     "inference/model_runner.py": {"*"},

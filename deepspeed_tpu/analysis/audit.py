@@ -2,7 +2,8 @@
 
 ``serve_jit_specs`` builds example arguments for every hot jit of an
 :class:`~deepspeed_tpu.inference.engine_v2.InferenceEngineV2` (decode,
-packed prefill, ctx-pack prefill, speculative verify) mirroring the
+megastep decode burst, packed prefill, ctx-pack prefill, speculative
+verify) mirroring the
 engine's own dispatch sites, lowers the engine's actual compiled callables
 (donation flags, out-shardings and all), and ``audit_serve_engine`` runs
 the donation / collective-budget / dtype / sharding passes over each.
@@ -92,6 +93,25 @@ def serve_jit_specs(eng, sampling=None) -> Dict[str, dict]:
         jit=eng._decode_jit,
         args=(eng.params, toks, lens, bt, act, eng.kv, key, tr),
         donated={"seq_lens": 2, "kv": 5, "rng": 6}, static=(7,),
+        n_tokens=B, sample_rows=B,
+    )
+
+    # megastep burst (PR 16): decode + on-device accumulation/termination.
+    # Same per-dispatch collective plan as plain decode; the burst carries
+    # (active, burst buffer, tick, emitted) as donated state while the
+    # per-slot stop/cap rows are deliberately NOT donated (they feed every
+    # fused tick) — the donation check proves both halves.
+    n_burst = 4
+    specs["decode_burst"] = dict(
+        jit=eng._decode_burst_jit,
+        args=(eng.params, toks, lens, bt, act, eng.kv, key,
+              jnp.full((n_burst + 1, B), -2, jnp.int32),
+              jnp.zeros((), jnp.int32), jnp.zeros(B, jnp.int32),
+              jnp.full(B, -1, jnp.int32), jnp.full(B, n_burst, jnp.int32),
+              tr),
+        donated={"seq_lens": 2, "active": 4, "kv": 5, "rng": 6, "burst": 7,
+                 "tick": 8, "emitted": 9},
+        static=(12,),
         n_tokens=B, sample_rows=B,
     )
 

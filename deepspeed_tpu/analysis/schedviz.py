@@ -30,10 +30,11 @@ Pieces:
   milliseconds, no jax required.
 - ``scenario_*`` — the hot concurrent scenarios the serve stack must
   survive (ISSUE 13): telemetry namespace claim/drop vs snapshot,
-  submit-vs-tick-vs-cancel, shed-mode entry/exit vs watchdog, and
-  worker-kill-vs-route.  Each raises ``AssertionError`` on an invariant
-  violation; :func:`run_scenarios` aggregates them for ``bench.py
-  --audit`` and the tier-1 gate.
+  submit-vs-tick-vs-cancel, shed-mode entry/exit vs watchdog,
+  worker-kill-vs-route, and cancel-vs-megastep (ISSUE 16: cancels landing
+  while the scheduler fuses decode ticks into one burst).  Each raises
+  ``AssertionError`` on an invariant violation; :func:`run_scenarios`
+  aggregates them for ``bench.py --audit`` and the tier-1 gate.
 """
 from __future__ import annotations
 
@@ -676,6 +677,30 @@ class HostStubEngine:
             out[seq.uid] = tok
         return out
 
+    def _decode_burst(self, seqs, sampling, n, max_emit=None,
+                      stop_tokens=None) -> Dict[int, List[int]]:
+        """Megastep burst double: same per-row contract as the real
+        ``InferenceEngineV2._decode_burst`` — up to ``n`` emissions per
+        row, clamped by ``max_emit`` and the engine length cap, stopping
+        a row early (stop token INCLUDED, like ``step()``) when its
+        per-request stop fires."""
+        out: Dict[int, List[int]] = {}
+        for seq in seqs:
+            cap = min(n, self.max_seq_len - seq.cur_len)
+            if max_emit is not None and seq.uid in max_emit:
+                cap = min(cap, max_emit[seq.uid])
+            stop = (stop_tokens or {}).get(seq.uid)
+            run: List[int] = []
+            for _ in range(max(0, cap)):
+                tok = self._tok(seq)
+                seq.tokens.append(tok)
+                run.append(tok)
+                if stop is not None and tok == stop:
+                    break
+            seq.seen_tokens = len(seq.tokens) - 1
+            out[seq.uid] = run
+        return out
+
     def plan_speculation(self, seqs, **kw) -> Dict[int, list]:
         return {}
 
@@ -1172,6 +1197,71 @@ def scenario_heartbeat_expiry_vs_route(seed: int, n_requests: int = 5) -> None:
         assert all(a.get("blocks_in_use", 0) == 0 for a in audits), audits
 
 
+def scenario_cancel_during_megastep(seed: int, n_requests: int = 4) -> None:
+    """Client cancels race the owner tick loop while the scheduler fuses
+    decode ticks into megastep bursts (``serve.decode_megastep`` > 1).
+    Invariants: a cancel landing mid-megastep takes effect at the next
+    burst boundary (the knob's documented latency bound) — every accepted
+    request still reaches exactly one terminal state; a burst never emits
+    past a request's ``max_new_tokens`` budget even though each tick now
+    commits several tokens; zero blocks leak."""
+    from ..config.config import ServeConfig
+    from ..inference.sampling import SamplingParams
+    from ..inference.scheduler import TERMINAL
+
+    sched = Schedule(seed, max_preemptions=32)
+    with sched.instrument():
+        eng, ss = _stub_scheduler(serve=ServeConfig(decode_megastep=4))
+        accepted: List[int] = []
+
+        def budget_invariant() -> None:
+            for uid in list(accepted):
+                req = ss.requests.get(uid)
+                if req is not None:
+                    assert (len(req.generated)
+                            <= req.sampling.max_new_tokens), (
+                        uid, req.generated)
+
+        def submitter() -> None:
+            for i in range(n_requests):
+                res = ss.try_submit(
+                    300 + i, [1, 2, 3],
+                    SamplingParams(temperature=0.0, max_new_tokens=6))
+                if res.accepted:
+                    accepted.append(300 + i)
+                budget_invariant()
+
+        def ticker() -> None:
+            for _ in range(10):
+                ss.tick()
+                budget_invariant()
+
+        def canceller() -> None:
+            ss.cancel(301)
+            ss.cancel(303)
+            ss.cancel(999)  # unknown uid: must be a quiet no-op
+            budget_invariant()
+
+        sched.spawn(submitter, name="submit")
+        sched.spawn(ticker, name="tick")
+        sched.spawn(canceller, name="cancel")
+        sched.run()
+
+        for _ in range(64):  # drain on the owner thread
+            if all(ss.requests[u].state in TERMINAL for u in accepted):
+                break
+            ss.tick()
+            budget_invariant()
+        states = {u: ss.requests[u].state for u in accepted}
+        assert all(s in TERMINAL for s in states.values()), states
+        for u in accepted:
+            toks = ss.pop_result(u)
+            assert len(toks) <= 6, (u, toks)
+        alloc = eng.mgr.allocator
+        assert alloc.available_blocks == alloc.total_blocks, (
+            f"leak: {alloc.total_blocks - alloc.available_blocks} blocks")
+
+
 SCENARIOS = (
     scenario_namespace_claims,
     scenario_submit_tick_cancel,
@@ -1179,6 +1269,7 @@ SCENARIOS = (
     scenario_kill_vs_route,
     scenario_replica_affine_admission,
     scenario_heartbeat_expiry_vs_route,
+    scenario_cancel_during_megastep,
 )
 
 

@@ -242,6 +242,17 @@ class ServeConfig:
     # many free-dim tiles reduced independently (T3-style overlap).
     quant_comm: str = "none"
     comm_tiles: int = 1
+    # megastep decode: fuse up to this many decode-only scheduler ticks
+    # into ONE device-resident engine burst (one host sync for the whole
+    # run of ticks; stop tokens / length caps are detected on device, so
+    # the fused ticks stay token-identical to per-tick decode).  1 = off.
+    # The scheduler adaptively collapses to per-tick whenever the tick has
+    # non-decode work (queued admissions, running prefills, live
+    # speculation proposals) and clamps the fuse count to the nearest
+    # request deadline — but deadline/cancel/watchdog checks still only
+    # run at megastep BOUNDARIES, so the reaction latency bound grows to
+    # decode_megastep x per-tick duration.
+    decode_megastep: int = 1
 
     def __post_init__(self):
         if self.quant_comm not in ("none", "int8", "fp8"):
@@ -251,6 +262,10 @@ class ServeConfig:
         if self.comm_tiles < 1:
             raise ConfigError(
                 f"serve.comm_tiles must be >= 1, got {self.comm_tiles}")
+        if self.decode_megastep < 1:
+            raise ConfigError(
+                f"serve.decode_megastep must be >= 1, got "
+                f"{self.decode_megastep}")
         for k in ("deadline_ms", "ttft_deadline_ms", "watchdog_tick_ms"):
             v = getattr(self, k)
             if v is not None and v <= 0:
@@ -408,6 +423,14 @@ class RouterConfig:
     rpc_backoff_max_ms: float = 250.0
     connect_timeout_ms: float = 30_000.0
     max_frame_bytes: int = 64 * 1024 * 1024
+    # wire-level megastep: scheduler ticks batched into ONE step_burst RPC
+    # per worker per router tick (1 = the classic begin/finish tick pair).
+    # The worker runs up to this many ticks back to back and replies once —
+    # router-side death discovery, cancel forwarding and terminal
+    # collection shift to megastep boundaries (latency bound:
+    # decode_megastep x worker tick duration).  Exactly-once replay is
+    # unchanged: the whole burst is one rid in the reply cache.
+    decode_megastep: int = 1
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -467,6 +490,10 @@ class RouterConfig:
             raise ConfigError(
                 f"router.max_frame_bytes must be >= 4096, got "
                 f"{self.max_frame_bytes}")
+        if self.decode_megastep < 1:
+            raise ConfigError(
+                f"router.decode_megastep must be >= 1, got "
+                f"{self.decode_megastep}")
 
 
 @dataclass

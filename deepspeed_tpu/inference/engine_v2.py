@@ -26,6 +26,12 @@ from .ragged import StateManager
 from .sampling import SamplingParams, finite_guard, sample
 
 
+# burst-accumulator pad written by rows already deactivated on device:
+# distinct from the -1 finite_guard poison sentinel (which is a real
+# emission — always a row's LAST — that the host must see to quarantine)
+_BURST_PAD = -2
+
+
 def _bucket(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -303,6 +309,9 @@ class InferenceEngineV2:
             "sampling_uploads",  # H2D copies of the per-slot sampling rows
             "decode_ticks",
             "decode_emitted",  # tokens emitted by plain decode dispatches
+            "decode_bursts",  # device-resident bursts (ONE host sync each)
+            "burst_ticks",  # decode dispatches fused inside bursts
+            "burst_emitted",  # tokens committed out of burst fetches
             "spec_ticks",  # verify dispatches (each scores k+1 positions)
             "spec_seq_forwards",  # sequence-participations in verify ticks
             "spec_drafted",  # draft tokens proposed
@@ -453,20 +462,62 @@ class InferenceEngineV2:
             return sampled, seq_lens + 1, rng, kv
 
         def decode_burst_impl(params, tokens, seq_lens, block_tables, active,
-                              kv, rng, burst, tick, sampling_triple):
-            """decode_impl + ON-DEVICE burst accumulation: each tick writes
-            its sampled row into the donated ``burst`` buffer.  The host
-            keeps references ONLY to the latest outputs — holding every
-            tick's token array alive was measured to stretch ticks from
-            ~14 ms to 20-70 ms on the tunnel-attached chip."""
-            sampled, seq_lens, rng, kv = decode_impl(
-                params, tokens, seq_lens, block_tables, active, kv, rng,
-                sampling_triple,
+                              kv, rng, burst, tick, emitted, stop_rows,
+                              max_emit, sampling_triple):
+            """decode_impl + ON-DEVICE burst accumulation AND termination:
+            each tick writes its sampled row into the donated ``burst``
+            buffer and updates the per-slot ``active`` mask IN the graph —
+            a row hitting its stop token, its emission cap, or the
+            finite_guard sentinel deactivates immediately, so later ticks
+            neither sample it nor write its KV (early-exit masking: the
+            mask gates ``write_decode_kv`` inside ``decode_step``).  The
+            single end-of-burst fetch therefore yields exactly the tokens
+            per-tick ``step()`` would have — no decode-past-stop.
+
+            Carries: ``active`` [B] bool (monotone-decreasing), ``emitted``
+            [B] int32 token counts (mirrored into ``burst`` row 0 so ONE
+            fetch returns counts + tokens), ``stop_rows`` [B] int32 per-slot
+            stop ids (-1 = none; NOT a static arg — per-request stop tokens
+            must not recompile), ``max_emit`` [B] int32 per-slot emission
+            caps (remaining budget AND max_seq_len headroom).  ``burst`` is
+            [cap+1, B]: row 0 = counts, row 1+t = tick t's emissions
+            (``_BURST_PAD`` where the row was already inactive; the -1
+            poison sentinel can only ever be a row's LAST emission).  The
+            host keeps references ONLY to the latest outputs — holding
+            every tick's token array alive was measured to stretch ticks
+            from ~14 ms to 20-70 ms on the tunnel-attached chip."""
+            logits, kv = model_runner.decode_step(
+                params, cfg_, tokens, seq_lens, block_tables, active, kv,
+                ctx=ctx_, mesh=mesh_, dp=dp_,
             )
+            t, k, p = sampling_triple
+            rng, sub = jax.random.split(rng)
+            sampled = finite_guard(
+                logits, sample(logits, SamplingParams(t, k, p), sub)
+            )
+            act_i = active.astype(jnp.int32)
+            emit = jnp.where(active, sampled, jnp.int32(_BURST_PAD))
             burst = jax.lax.dynamic_update_index_in_dim(
-                burst, sampled, tick, axis=0
+                burst, emit, tick + 1, axis=0
             )
-            return sampled, seq_lens, rng, kv, burst, tick + 1
+            emitted = emitted + act_i
+            burst = burst.at[0].set(emitted)
+            # termination checks AFTER this tick's emission: the stop token
+            # itself is emitted (step() appends it before finishing), the
+            # poison sentinel is emitted (the host commits the healthy
+            # prefix and quarantines), and a row emits exactly max_emit
+            poisoned = sampled < 0
+            hit_stop = (stop_rows >= 0) & (sampled == stop_rows)
+            active = active & ~poisoned & ~hit_stop & (emitted < max_emit)
+            # lengths advance only for rows that emitted this tick — a
+            # finished row's seq_lens freezes, so its attention window and
+            # block-table reads never run past its reserved pages
+            seq_lens = seq_lens + act_i
+            # next tick's input token (clamped: the -1 sentinel must not
+            # index the embedding; the row is inactive anyway)
+            tokens = jnp.where(active, jnp.maximum(sampled, 0), tokens)
+            return (tokens, seq_lens, rng, kv, burst, tick + 1, active,
+                    emitted)
 
         def spec_impl(params, tokens, seg, pos, dst_pages, dst_offs,
                       ctx_tables, ctx_lens, draft, n_draft, samp_rows, kv,
@@ -521,10 +572,13 @@ class InferenceEngineV2:
                 decode_impl, donate_argnums=(2, 5, 6), static_argnums=(7,),
                 out_shardings=(rep, rep, rep, self._kv_shardings),
             )
+            # stop_rows/max_emit are NOT donated: the same device arrays
+            # feed every tick of a burst
             self._decode_burst_jit = jax.jit(
-                decode_burst_impl, donate_argnums=(2, 5, 6, 7, 8),
-                static_argnums=(9,),
-                out_shardings=(rep, rep, rep, self._kv_shardings, rep, rep),
+                decode_burst_impl, donate_argnums=(2, 4, 5, 6, 7, 8, 9),
+                static_argnums=(12,),
+                out_shardings=(rep, rep, rep, self._kv_shardings, rep, rep,
+                               rep, rep),
             )
             self._spec_jit = jax.jit(
                 spec_impl, donate_argnums=(11,), static_argnums=(13, 14),
@@ -549,8 +603,8 @@ class InferenceEngineV2:
             )
             self._decode_burst_jit = self._wrap_offload(
                 jax.jit(
-                    decode_burst_impl, donate_argnums=(2, 5, 6, 7, 8),
-                    static_argnums=(9,),
+                    decode_burst_impl, donate_argnums=(2, 4, 5, 6, 7, 8, 9),
+                    static_argnums=(12,),
                 ),
                 kv_rest_idx=4,
             )
@@ -1424,17 +1478,167 @@ class InferenceEngineV2:
             out[s.uid] = run[-1]
         return out
 
+    def _decode_burst(
+        self, active_seqs, sampling: SamplingParams, n: int,
+        max_emit: Optional[Dict[int, int]] = None,
+        stop_tokens: Optional[Dict[int, Optional[int]]] = None,
+    ) -> Dict[int, List[int]]:
+        """Device-resident multi-tick decode core: up to ``n`` fused decode
+        dispatches over ``active_seqs`` with ON-DEVICE termination and ONE
+        end-of-burst fetch.  ``step_n`` and the scheduler's megastep both
+        ride this.
+
+        Per-slot stop tokens (``stop_tokens`` {uid: id}, default the shared
+        ``sampling.stop_token``) and emission caps (``max_emit`` {uid: n},
+        additionally clamped by ``max_seq_len`` headroom) ride device
+        arrays into the burst jit, which deactivates each row the tick it
+        stops — later ticks neither sample it nor write its KV, so the
+        fetched runs are token-identical to per-tick ``step()`` decode
+        (no decode-past-stop).
+
+        One dispatch PER TICK (donation keeps the multi-GB KV pool updating
+        in place — a fused lax.scan burst was measured 5x slower: the pool
+        stops aliasing inside the loop carry), but only ONE host sync per
+        burst AND zero per-tick uploads: tokens, seq_lens, the rng key, the
+        active mask, the emission counts and the [cap+1, B] burst
+        accumulator are all device arrays chained tick-to-tick.  The host
+        must NOT retain per-tick outputs (holding every tick's token array
+        alive was measured to stretch ticks from ~14 ms to 20-70 ms).
+
+        Returns {uid: emitted run}.  A poisoned row quarantines AT its
+        first bad tick on device (the mask drops it; later ticks never
+        attend over its suspect KV): its run ends with the -1 sentinel,
+        the healthy prefix before it is committed, and its published cache
+        keys are retracted.  A chaos-injected ``nan_logits`` poison applies
+        at burst granularity: nothing commits, run = [-1].  Rows given no
+        emission headroom return an empty run untouched."""
+        B = self.mgr.max_seqs
+        uids = [s.uid for s in active_seqs]
+        base_lens = np.zeros(B, np.int32)
+        tokens0 = np.zeros(B, np.int32)
+        active = np.zeros(B, bool)
+        stop_rows = np.full(B, -1, np.int32)
+        emit_cap = np.zeros(B, np.int32)
+        for s in active_seqs:
+            cap_i = min(n, self.max_seq_len - s.cur_len)
+            if max_emit is not None and s.uid in max_emit:
+                cap_i = min(cap_i, int(max_emit[s.uid]))
+            if cap_i < 1:
+                continue  # no headroom: empty run, row never enters the batch
+            # pre-reserve every page this row's burst can touch: the block
+            # tables are then static for all its ticks (one upload); rows
+            # stopping early hand the unused tail back after the fetch
+            self.mgr.ensure_capacity(s, cap_i)
+            self.mgr.ensure_writable(s, s.cur_len - 1)
+            self._set_block_table(s)
+            base_lens[s.slot] = s.cur_len - 1
+            tokens0[s.slot] = s.tokens[-1]
+            active[s.slot] = True
+            emit_cap[s.slot] = cap_i
+            st = sampling.stop_token if stop_tokens is None \
+                else stop_tokens.get(s.uid, sampling.stop_token)
+            stop_rows[s.slot] = -1 if st is None else int(st)
+        if not active.any():
+            return {u: [] for u in uids}
+        # no tick can emit once every row is past its cap — clamp the burst
+        n = min(n, int(emit_cap.max()))
+        self._maybe_fault("runner_exception", uids)
+        tables = self._tables_device()
+        tokens_dev = self._commit_rep(tokens0)
+        lens_dev = self._commit_rep(base_lens)
+        active_dev = self._commit_rep(active)
+        emitted_dev = self._commit_rep(np.zeros(B, np.int32))
+        stop_dev = self._commit_rep(stop_rows)
+        cap_dev = self._commit_rep(emit_cap)
+        self._rng, key_dev = jax.random.split(self._rng)
+        key_dev = self._commit_rep(key_dev)
+        triple = (sampling.temperature, sampling.top_k, sampling.top_p)
+        # fixed burst capacity -> one compiled program for every n
+        cap = self._burst_cap
+        while cap < n:
+            cap *= 2
+        self._burst_cap = cap
+        # [cap+1, B]: row 0 carries the per-slot emission counts, row 1+t
+        # tick t's emissions — counts and tokens come back in ONE fetch
+        buf = np.full((cap + 1, B), _BURST_PAD, np.int32)
+        buf[0] = 0
+        burst_dev = self._commit_rep(buf)
+        tick_dev = self._commit_rep(np.zeros((), np.int32))
+        # ONE span for the whole burst — per-tick spans would retain one
+        # device array per tick, the exact host-reference leak this design
+        # removes; the per-tick figure is the burst average, observed once
+        # per tick
+        sp = self.telemetry.recorder.start(
+            "decode_burst", track=self._ns, ticks=n, batch=len(active_seqs),
+        )
+        with self.telemetry.step_annotation("decode_burst", n):
+            for _ in range(n):
+                (tokens_dev, lens_dev, key_dev, self.kv, burst_dev,
+                 tick_dev, active_dev, emitted_dev) = self._decode_burst_jit(
+                    self.params, tokens_dev, lens_dev, tables, active_dev,
+                    self.kv, key_dev, burst_dev, tick_dev, emitted_dev,
+                    stop_dev, cap_dev, triple,
+                )
+        sp.dispatched()
+        # a burst is n decode dispatches: account their TP wire bytes —
+        # per-tick plan x n, ONE block-table upload (the same enumeration
+        # the Graft Auditor checks against the burst jit's compiled HLO)
+        self._account_comm(B, reps=n)
+        self._c["decode_bursts"].inc()
+        self._c["burst_ticks"].inc(n)
+        burst = np.asarray(burst_dev)[: n + 1]  # the ONE host sync
+        sp = sp.end()
+        if sp.duration_ms is not None:
+            per_tick = sp.duration_ms / n
+            for _ in range(n):
+                self._h["burst_tick_ms"].observe(per_tick)
+        poison_inj = self._poisoned(uids)
+        out: Dict[int, List[int]] = {}
+        total = 0
+        for s in active_seqs:
+            if not active[s.slot]:
+                out[s.uid] = []
+                continue
+            m = int(burst[0, s.slot])
+            run = [int(t) for t in burst[1: 1 + m, s.slot]]
+            if s.uid in poison_inj:
+                # chaos-injected poison: same contract as a tick-0 device
+                # sentinel — nothing committed, the row quarantined
+                run, committed = [-1], []
+            elif run and run[-1] == -1:
+                committed = run[:-1]
+            else:
+                committed = run
+            s.tokens.extend(committed)
+            s.seen_tokens = s.cur_len - 1
+            if run and run[-1] < 0:
+                # the row deactivated at its first bad tick on device; its
+                # published keys are retracted (written KV is suspect)
+                s.error = "non-finite logits in decode burst"
+                self.mgr.quarantine_written(s)
+            else:
+                self.mgr.update_hashes(s)
+            # hand back the unused tail reservation (early-stopped rows) /
+            # the poisoned tick's growth block in one truncate
+            if self.mgr.truncate_to_length(s):
+                self._set_block_table(s)
+            total += len(committed)
+            out[s.uid] = run
+        self._c["burst_emitted"].inc(total)
+        return out
+
     def step_n(self, n: int, sampling: SamplingParams = SamplingParams()) -> Dict[int, int]:
         """``n`` pipelined decode ticks: sampled tokens stay ON DEVICE
         between ticks (each tick's output feeds the next tick's input
         directly), so the host round trip — which dominates per-tick latency
         on remote-attached chips — is paid ONCE per burst, not per token.
 
-        The tradeoff is the reference FastGen's async-scheduling one: stop
-        tokens are detected when the burst's tokens are fetched, so a
-        sequence may decode up to ``n-1`` tokens past its stop (they are
-        dropped, their KV pages simply carry garbage past the end).  Returns
-        {uid: last kept token}.
+        Stop-EXACT: the burst jit checks each row's stop token and length
+        cap on device and deactivates it the tick it finishes, so the
+        fetched tokens are identical to ``n`` per-tick ``step()`` calls —
+        the reference FastGen's async-scheduling caveat (decoding up to
+        ``n-1`` tokens past a stop) is retired.  Returns
+        {uid: last kept token} (-1 for a poisoned row, same as ``step()``).
         """
         active_seqs = [s for s in self.mgr.active if not s.done]
         if not active_seqs or n <= 0:
@@ -1448,96 +1652,29 @@ class InferenceEngineV2:
         active_seqs = [s for s in active_seqs if not s.done]
         if not active_seqs:
             return {}
-        # bound the burst so the longest remaining sequence cannot overflow
-        n = min(n, self.max_seq_len - max(s.cur_len for s in active_seqs))
-        B = self.mgr.max_seqs
-        # pre-allocate every page the burst can touch: the block tables are
-        # then static for all n ticks (one upload)
-        base_lens = np.zeros(B, np.int32)
-        tokens0 = np.zeros(B, np.int32)
-        active = np.zeros(B, bool)
-        for s in active_seqs:
-            self.mgr.ensure_capacity(s, n)
-            self.mgr.ensure_writable(s, s.cur_len - 1)
-            self._set_block_table(s)
-            base_lens[s.slot] = s.cur_len - 1
-            tokens0[s.slot] = s.tokens[-1]
-            active[s.slot] = True
-        # one dispatch PER TICK (donation keeps the multi-GB KV pool
-        # updating in place — a fused lax.scan burst was measured 5x slower:
-        # the pool stops aliasing inside the loop carry), but only ONE host
-        # sync per burst AND zero per-tick uploads: tokens, seq_lens, the
-        # rng key, the tick counter and the [cap, B] burst accumulator are
-        # all device arrays chained tick-to-tick.  The host must NOT retain
-        # per-tick outputs (holding every tick's token array alive was
-        # measured to stretch ticks from ~14 ms to 20-70 ms); the burst
-        # buffer accumulates rows on device and is fetched once.
-        tables = self._tables_device()
-        active_j = jnp.asarray(active)
-        tokens_dev = self._commit_rep(tokens0)
-        lens_dev = self._commit_rep(base_lens)
-        self._rng, key_dev = jax.random.split(self._rng)
-        key_dev = self._commit_rep(key_dev)
-        triple = (sampling.temperature, sampling.top_k, sampling.top_p)
-        # fixed burst capacity -> one compiled program for every n
-        cap = self._burst_cap
-        while cap < n:
-            cap *= 2
-        self._burst_cap = cap
-        burst_dev = self._commit_rep(np.zeros((cap, B), np.int32))
-        tick_dev = self._commit_rep(np.zeros((), np.int32))
-        # ONE span for the whole burst — per-tick spans would retain one
-        # device array per tick, the exact host-reference leak step_n's
-        # design removes (14 ms -> 20-70 ms ticks measured); the per-tick
-        # figure is the burst average, observed once per tick
-        sp = self.telemetry.recorder.start(
-            "decode_burst", track=self._ns, ticks=n, batch=len(active_seqs),
-        )
-        with self.telemetry.step_annotation("decode_burst", n):
-            for _ in range(n):
-                (tokens_dev, lens_dev, key_dev, self.kv, burst_dev,
-                 tick_dev) = self._decode_burst_jit(
-                    self.params, tokens_dev, lens_dev, tables,
-                    active_j, self.kv, key_dev, burst_dev, tick_dev, triple,
-                )
-        sp.dispatched()
-        # a burst is n decode dispatches: account their TP wire bytes (the
-        # per-tick _decode_tick path does the same accounting per call)
-        self._account_comm(B, reps=n)
-        burst = np.asarray(burst_dev)[:n]  # [n, B] — the ONE host sync
-        sp = sp.end()
-        if sp.duration_ms is not None:
-            per_tick = sp.duration_ms / n
-            for _ in range(n):
-                self._h["burst_tick_ms"].observe(per_tick)
+        # rows terminate at their own length caps on device, so the burst
+        # length follows the LEAST constrained row (the old host clamp to
+        # the shortest headroom starved healthy batchmates)
+        n = min(n, self.max_seq_len - min(s.cur_len for s in active_seqs))
+        runs = self._decode_burst(active_seqs, sampling, n)
         out: Dict[int, int] = {}
         for s in active_seqs:
-            row = [int(t) for t in burst[:, s.slot]]
-            poisoned = -1 in row
-            if poisoned:
-                # finite_guard sentinel mid-burst: keep the healthy prefix,
-                # drop everything from the poisoned tick on (later ticks fed
-                # the sentinel back as input and are garbage)
-                row = row[: row.index(-1)]
+            run = runs[s.uid]
+            if not run:
+                continue
+            if run[-1] < 0:
+                # poisoned rows report the sentinel, same contract as
+                # step(): the caller must not mistake a stale committed
+                # token for a fresh emission from a failed sequence
                 s.done = True
-                s.error = "non-finite logits in decode burst"
-            if sampling.stop_token is not None and sampling.stop_token in row:
-                row = row[: row.index(sampling.stop_token) + 1]
+                out[s.uid] = -1
+                continue
+            if sampling.stop_token is not None \
+                    and run[-1] == sampling.stop_token:
                 s.done = True
-            s.tokens.extend(row)
-            s.seen_tokens = s.cur_len - 1
-            if poisoned:
-                # a poisoned burst's KV is suspect — retract the keys this
-                # sequence published rather than serve them as cache hits
-                self.mgr.quarantine_written(s)
-            else:
-                self.mgr.update_hashes(s)
             if s.cur_len >= self.max_seq_len:
                 s.done = True
-            # poisoned rows report the sentinel, same contract as step():
-            # the caller must not mistake a stale committed token for a
-            # fresh emission from a failed sequence
-            out[s.uid] = -1 if poisoned else s.tokens[-1]
+            out[s.uid] = run[-1]
         return out
 
     def flush(self, uids: Sequence[int]) -> None:

@@ -13,15 +13,21 @@ trustworthy if its failure paths run in CI — so this module provides the
                                   real block arithmetic) — emulates an
                                   allocator race / transient pool pressure
     ``runner_exception``          engine dispatch sites (``_decode_tick``,
-                                  ``_spec_tick``, ``_run_packed_prefill``)
-                                  just before the jit call — emulates a device
-                                  runtime error.  Raised BEFORE dispatch so the
-                                  donated KV pool is never half-consumed.
+                                  ``_spec_tick``, ``_run_packed_prefill``,
+                                  ``_decode_burst`` — one check per megastep
+                                  burst) just before the jit call — emulates a
+                                  device runtime error.  Raised BEFORE dispatch
+                                  so the donated KV pool is never
+                                  half-consumed.
     ``nan_logits``                after the dispatch's token fetch: the
                                   engine poisons the victim rows with the same
                                   ``-1`` sentinel the in-jit ``finite_guard``
                                   produces for real non-finite logits, so the
-                                  whole host-side quarantine path runs.
+                                  whole host-side quarantine path runs.  In a
+                                  megastep burst the injection applies at
+                                  BURST granularity (the row quarantines with
+                                  nothing committed, as if poisoned at its
+                                  first fused tick).
     ``slow_tick``                 scheduler tick start (``delay()`` seconds) —
                                   trips the tick-duration watchdog
     ``checkpoint_crash``          ``checkpoint/saving.py`` between the shard

@@ -245,6 +245,10 @@ class ServeScheduler:
             "adopted",  # requests adopted mid-flight (the receiving side)
         ))
         self._tick_ms_ema: Optional[float] = None  # retry_after_ms basis
+        # decode ticks fused into this tick's device burst (megastep): 1 =
+        # per-tick decode; read by tick() to normalize the watchdog's
+        # measured duration back to a per-device-tick figure
+        self._last_fused = 1
         # fault-tolerance transitions count in the paired SERVE namespace
         # (they are serve-level events; the engine's stats view lists them
         # too — registry counters are memoized by name, so these are the
@@ -980,16 +984,70 @@ class ServeScheduler:
         # the predictable-latency path the watchdog wants
         return self.engine.enable_speculation and not self._shed
 
+    def _remaining_emit(self, req: ServeRequest) -> int:
+        """Tokens ``req`` may still emit: its ``max_new_tokens`` budget and
+        the engine's ``max_seq_len`` headroom (>= 1 for a live DECODE
+        request — anything at either cap finished last tick)."""
+        seq = self.engine.mgr.seqs[req.uid]
+        return max(1, min(req.sampling.max_new_tokens - len(req.generated),
+                          self.engine.max_seq_len - seq.cur_len))
+
+    def _plan_megastep(self, decoding: List[ServeRequest],
+                       proposals) -> int:
+        """Decode ticks to fuse into ONE device burst this tick (megastep).
+
+        ``serve.decode_megastep`` is the ceiling; the plan adaptively
+        collapses to per-tick (1) whenever the tick has non-decode work —
+        queued admissions, running requests still in PREFILL, or live
+        speculation proposals (verify ticks stay per-tick; megastep applies
+        when spec is off, shed, or throttled to zero drafts) — and clamps
+        the fuse count to the nearest survivor deadline (headroom over the
+        per-tick duration EMA).  Per-row stop/emission caps ride the burst
+        ON DEVICE, so early-finishing rows never decode past their stop;
+        the count only follows the LEAST constrained row's budget.
+
+        Deadline/cancel/watchdog phases keep running at tick (= megastep)
+        boundaries: fusing n ticks bounds their added reaction latency by
+        n x per-tick duration — the knob's documented tradeoff."""
+        n = self.serve.decode_megastep
+        if n <= 1 or self.waiting:
+            return 1
+        live = [r for r in decoding if r.state == DECODE]
+        if not live:
+            return 1
+        with self._lock:
+            if any(r.state == PREFILL for r in self._running):
+                return 1
+        if self._speculating and proposals:
+            return 1
+        per_tick_ms = max(self._tick_ms_ema or 1.0, 0.05)
+        now = self._clock()
+        for req in live:
+            dl = self._deadline_of(req)
+            if dl is not None:
+                headroom_ms = dl - (now - req.submit_time) * 1e3
+                n = min(n, max(1, int(headroom_ms / per_tick_ms)))
+        return max(1, min(n, max(self._remaining_emit(r) for r in live)))
+
     def _dispatch_decode(self, survivors: List[ServeRequest],
-                         proposals) -> Dict[int, List[int]]:
+                         proposals, n_fuse: int = 1) -> Dict[int, List[int]]:
         """Guarded decode/verify dispatch: transient retry with backoff,
         then per-request solo isolation (each survivor dispatched alone;
-        only those whose own dispatch fails are quarantined)."""
+        only those whose own dispatch fails are quarantined).  With
+        ``n_fuse`` > 1 the dispatch is one megastep burst — up to n_fuse
+        fused decode ticks with per-request stop tokens and emission caps
+        enforced on device."""
         eng = self.engine
         mgr = eng.mgr
 
         def run(reqs: List[ServeRequest]) -> Dict[int, List[int]]:
             seqs = [mgr.seqs[r.uid] for r in reqs]
+            if n_fuse > 1:
+                return eng._decode_burst(
+                    seqs, self._base_sampling(), n_fuse,
+                    max_emit={r.uid: self._remaining_emit(r) for r in reqs},
+                    stop_tokens={r.uid: r.sampling.stop_token for r in reqs},
+                )
             if self._speculating:
                 props = {r.uid: proposals[r.uid] for r in reqs
                          if r.uid in proposals}
@@ -1054,14 +1112,22 @@ class ServeScheduler:
                     max_emit={q.uid: q.sampling.max_new_tokens
                               - len(q.generated) for q in reqs},
                 ))
+        # megastep plan: how many decode ticks this tick fuses into one
+        # device burst (1 = classic per-tick decode / verify)
+        n_fuse = self._plan_megastep(decoding, proposals)
         for req in decoding:
             if req.state != DECODE:  # preempted by an earlier victim pick
                 continue
             seq = mgr.seqs[req.uid]
             grow_retries = 0
             while True:
+                # a megastep pre-reserves each row's full burst headroom so
+                # its block table is static across the fused ticks; unused
+                # tail reservations come back after the burst's fetch
+                need = min(n_fuse, self._remaining_emit(req)) if n_fuse > 1 \
+                    else 1 + len(proposals.get(req.uid, ()))
                 try:
-                    mgr.ensure_capacity(seq, 1 + len(proposals.get(req.uid, ())))
+                    mgr.ensure_capacity(seq, need)
                     mgr.ensure_writable(seq, seq.cur_len - 1)
                     break
                 except RuntimeError as e:
@@ -1082,6 +1148,13 @@ class ServeScheduler:
                     if proposals.pop(req.uid, None):
                         self._c["drafts_shed"].inc()
                         continue
+                    if n_fuse > 1:
+                        # real pool pressure: collapse the megastep to a
+                        # single tick before evicting anyone — residency
+                        # beats amortization (plain decode needs only one
+                        # page of growth)
+                        n_fuse = 1
+                        continue
                     victim = self._pick_victim(exclude=req)
                     if victim is None:
                         raise RuntimeError(
@@ -1095,11 +1168,14 @@ class ServeScheduler:
         survivors = [r for r in decoding if r.state == DECODE]
         if not survivors:
             return out
-        runs = self._dispatch_decode(survivors, proposals)
+        runs = self._dispatch_decode(survivors, proposals, n_fuse)
+        self._last_fused = max(1, n_fuse)
         for req in survivors:
             if req.state != DECODE or req.uid not in runs:
                 continue  # failed in isolation (already released)
             emitted = runs[req.uid]
+            if not emitted:
+                continue  # no emission headroom this burst
             if emitted and emitted[-1] < 0:
                 # engine sentinel: non-finite logits in this row's forward
                 self._fail(req, mgr.seqs[req.uid].error
@@ -1244,8 +1320,13 @@ class ServeScheduler:
             self._admit_phase()
             decoding = [r for r in self._running if r.state == DECODE]
             out = self._prefill_phase()
+            self._last_fused = 1
             out.update(self._decode_phase(decoding))
-            self._update_degradation((self._clock() - t0) * 1e3)
+            # a megastep deliberately makes the tick n_fuse x longer —
+            # normalize the watchdog/EMA duration back to per-device-tick
+            # so fused decode cannot trip the slow-tick shed path
+            self._update_degradation(
+                (self._clock() - t0) * 1e3 / self._last_fused)
             if self.engine.mgr.replicas > 1:
                 # per-replica hit/headroom/spec-accept gauges: cheap host
                 # math, refreshed at the tick boundary (engine doubles

@@ -132,17 +132,22 @@ class Worker:
             uid, tokens, sampling, deadline_ms=deadline_ms,
             ttft_deadline_ms=ttft_deadline_ms)
 
-    def begin_tick(self) -> None:
-        """In-process: the tick runs synchronously here.  (The remote
+    def begin_tick(self, n: int = 1) -> None:
+        """In-process: the tick(s) run synchronously here.  (The remote
         worker posts the RPC and collects it in ``finish_tick`` so N
-        workers' forwards overlap across processes.)"""
-        self.scheduler.tick()
+        workers' forwards overlap across processes.)  ``n`` > 1 is the
+        in-process mirror of the ``step_burst`` RPC: up to n scheduler
+        ticks back to back, stopping early once the scheduler goes idle."""
+        for _ in range(max(1, n)):
+            self.scheduler.tick()
+            if self.scheduler.idle:
+                break
 
     def finish_tick(self) -> None:
         pass
 
-    def tick(self) -> None:
-        self.begin_tick()
+    def tick(self, n: int = 1) -> None:
+        self.begin_tick(n)
         self.finish_tick()
 
     def request_view(self, uid: int):

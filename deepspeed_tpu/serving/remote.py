@@ -292,6 +292,7 @@ class RemoteWorker:
         self._load: Dict[str, Any] = {}
         self._views: Dict[int, _ReqView] = {}
         self._tick_rid: Optional[int] = None
+        self.last_burst_ticks = 1  # worker ticks the last finish collected
         cfg = self.config
         self.client = RpcClient(
             self._dial_rpc,
@@ -390,11 +391,20 @@ class RemoteWorker:
             return SubmitResult(uid, RETRY_LATER, f"worker unreachable: {e}",
                                 retry_after_ms=self.config.retry_backoff_ms)
 
-    def begin_tick(self) -> None:
+    def begin_tick(self, n: int = 1) -> None:
         """Pipelined tick: post the op now, collect in ``finish_tick`` —
-        N workers' forward passes overlap across processes."""
+        N workers' forward passes overlap across processes.  ``n`` > 1
+        posts ONE ``step_burst`` RPC covering up to n worker ticks (the
+        wire half of megastep decode) instead of n tick round trips; the
+        per-token results demux off the reply's cumulative counts in
+        ``finish_tick``.  Exactly-once semantics and death replay are
+        unchanged — the burst is a single rid, and a worker dying mid-burst
+        surfaces exactly like one dying mid-tick (transport dead, the
+        router replays its requests from the prompt)."""
         if self._tick_rid is None:
-            self._tick_rid = self.client.post({"op": "tick"})
+            op = {"op": "tick"} if n <= 1 \
+                else {"op": "step_burst", "n": int(n)}
+            self._tick_rid = self.client.post(op)
 
     def finish_tick(self) -> None:
         rid, self._tick_rid = self._tick_rid, None
@@ -415,9 +425,10 @@ class RemoteWorker:
                 cancel_requested=bool(r.get("cancel_requested")),
             )
         self._views = views
+        self.last_burst_ticks = int(reply.get("ticks", 1))
 
-    def tick(self) -> None:
-        self.begin_tick()
+    def tick(self, n: int = 1) -> None:
+        self.begin_tick(n)
         self.finish_tick()
 
     def request_view(self, uid: int) -> Optional[_ReqView]:

@@ -487,7 +487,12 @@ class Router:
             if not w.healthy():
                 self._kill_worker(w, discovered=True)
                 continue
-            w.begin_tick()
+            # one pipelined RPC per worker per megastep: with
+            # decode_megastep > 1 each remote worker runs up to that many
+            # ticks behind a single step_burst rid (in-process workers run
+            # them synchronously) — death discovery/cancel/collection move
+            # to megastep boundaries, bounded by n x worker tick duration
+            w.begin_tick(self.config.decode_megastep)
             ticked.append(w)
         for w in ticked:
             w.finish_tick()

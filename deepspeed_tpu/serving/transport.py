@@ -1273,10 +1273,9 @@ class WorkerServer:
         )
         return {"result": self._submit_result(res)}
 
-    def _op_tick(self, op, blobs):
+    def _request_views(self) -> Dict[str, Any]:
         from ..inference.scheduler import DECODE
 
-        self.scheduler.tick()
         reqs = {}
         for uid, req in self.scheduler.requests.items():
             reqs[str(uid)] = {
@@ -1285,7 +1284,32 @@ class WorkerServer:
                 "cancel_requested": bool(req.cancel_requested),
                 "decoding": req.state == DECODE,
             }
-        return {"requests": reqs, "tick_no": self.scheduler.tick_no}
+        return reqs
+
+    def _op_tick(self, op, blobs):
+        self.scheduler.tick()
+        return {"requests": self._request_views(),
+                "tick_no": self.scheduler.tick_no}
+
+    def _op_step_burst(self, op, blobs):
+        """Up to ``n`` scheduler ticks in ONE exactly-once RPC — the wire
+        half of megastep decode (the in-engine half fuses each tick's
+        decode phase into a device burst).  Ticks run back to back on the
+        engine owner thread, stopping early once the scheduler goes idle;
+        the reply carries the FINAL request views plus the tick count run,
+        and the router demuxes per-token progress off the cumulative
+        ``generated`` counts.  Exactly-once replay is unchanged: the whole
+        burst is one rid in the reply cache, so a replayed request frame
+        returns the cached reply instead of running the ticks again."""
+        n = max(1, int(op.get("n", 1)))
+        ticks = 0
+        for _ in range(n):
+            self.scheduler.tick()
+            ticks += 1
+            if self.scheduler.idle:
+                break
+        return {"requests": self._request_views(),
+                "tick_no": self.scheduler.tick_no, "ticks": ticks}
 
     def _op_pop(self, op, blobs):
         uid = int(op["uid"])

@@ -219,14 +219,16 @@ def solo_engine():
 # green runs: the audit over every real hot jit (the CI gate)
 # ---------------------------------------------------------------------------
 def test_audit_green_on_tp_engine_all_hot_jits(tp_engine):
-    """ACCEPTANCE: decode, packed prefill, ctx-pack prefill and the
-    speculative verify jit all pass donation + collective-budget + dtype
-    audits on clean HEAD, and the TP param shardings pass the lint —
-    with the int8 transport, where the budget also proves the analytic
-    ``comm/bytes_on_wire`` accounting matches the compiled program."""
+    """ACCEPTANCE: decode, the megastep decode burst, packed prefill,
+    ctx-pack prefill and the speculative verify jit all pass donation +
+    collective-budget + dtype audits on clean HEAD, and the TP param
+    shardings pass the lint — with the int8 transport, where the budget
+    also proves the analytic ``comm/bytes_on_wire`` accounting matches
+    the compiled program."""
     report = audit_serve_engine(tp_engine)
     assert set(report["jits"]) == {
-        "decode", "prefill_packed", "prefill_packed_ctx", "verify"}
+        "decode", "decode_burst", "prefill_packed", "prefill_packed_ctx",
+        "verify"}
     for name, j in report["jits"].items():
         assert j["passed"], (name, j["checks"])
         assert j["collectives"] > 0  # a TP jit with no collectives is wrong

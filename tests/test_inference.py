@@ -287,8 +287,13 @@ def test_step_n_stop_token_truncates():
     eng2.step_n(4, samp)
     s2 = eng2.mgr.seqs[1]
     assert s2.done
+    # EXACT truncation (PR 16): on-device stop detection deactivates the
+    # row inside the burst at the FIRST stop occurrence, so the sequence
+    # holds exactly the per-tick step() tokens — the stop token is the
+    # LAST, nothing decoded past it
+    first = seq.tokens[3:].index(int(stop))
+    assert s2.tokens == seq.tokens[: 3 + first + 1], (s2.tokens, seq.tokens)
     assert s2.tokens[-1] == int(stop)
-    assert len(s2.tokens) <= len(seq.tokens)
 
 
 def test_v2_moe_matches_v1_dense():

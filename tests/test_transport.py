@@ -572,6 +572,98 @@ def test_router_over_sockets_discovers_death_and_replays(stub_server):
     assert res.reason == "retry_later" and "no live workers" in res.detail
 
 
+def test_step_burst_op_fuses_ticks_exactly_once(stub_server):
+    """The megastep wire op: one ``step_burst`` RPC runs up to n owner
+    ticks (early exit on idle), and a replayed request frame after a lost
+    response hits the reply cache instead of running the ticks again."""
+    srv = stub_server(decode_megastep=4)
+    c = _client(srv)
+    reply, _ = c.call({"op": "submit", "uid": 1, "tokens": [1, 2, 3],
+                       "sampling": {"max_new_tokens": 6}})
+    assert reply["ok"]
+    reply, _ = c.call({"op": "step_burst", "n": 4})
+    assert 1 <= reply["ticks"] <= 4
+    assert reply["tick_no"] == srv.scheduler.tick_no
+    # lose the connection BEFORE reading the next burst's response — the
+    # same-rid retry must be served from the exactly-once cache, not
+    # re-tick the scheduler
+    rid = c.post({"op": "step_burst", "n": 4})
+    deadline = time.monotonic() + 5.0
+    while rid not in srv._replies:
+        assert time.monotonic() < deadline, "server never executed the op"
+        time.sleep(0.01)
+    tick_no = srv.scheduler.tick_no
+    c._drop_stream()
+    reply, _ = c.wait(rid)
+    assert reply["tick_no"] == tick_no
+    assert srv.scheduler.tick_no == tick_no, "burst re-executed on replay"
+    # drain and pop: views carried cumulative progress the whole way
+    while srv.scheduler.requests[1].state not in ("finished",):
+        reply, _ = c.call({"op": "step_burst", "n": 4})
+    reply, _ = c.call({"op": "pop", "uid": 1})
+    assert len(reply["result"]["tokens"]) == 6
+    c.close()
+
+
+def test_router_megastep_death_mid_burst_replays(stub_server):
+    """Router at ``decode_megastep=4`` posts ONE pipelined step_burst RPC
+    per worker per megastep; a worker dying mid-burst is discovered via
+    the heartbeat lease and its requests replay TOKEN-IDENTICALLY on the
+    survivor (replay-from-prompt: cumulative demux never double-counts a
+    half-run burst)."""
+    srv0, srv1 = (stub_server(decode_megastep=4),
+                  stub_server(decode_megastep=4))
+    cfg = RouterConfig(n_workers=2, decode_megastep=4,
+                       heartbeat_interval_ms=20.0, lease_ms=200.0,
+                       rpc_backoff_ms=1.0, rpc_backoff_max_ms=5.0,
+                       rpc_max_attempts=3)
+    mon = HeartbeatMonitor(interval_ms=cfg.heartbeat_interval_ms,
+                           lease_ms=cfg.lease_ms)
+    tel = Telemetry(True)
+    workers = [
+        RemoteWorker(i, "127.0.0.1", srv.port, mon, config=cfg)
+        for i, srv in enumerate((srv0, srv1))
+    ]
+    mon.start()
+    router = Router(_RemoteTestPool(workers, tel, mon), cfg)
+    # long generations so the freeze below lands with bursts still
+    # in flight (megastep moves ~16x more tokens per router tick)
+    samp = SamplingParams(temperature=0.0, max_new_tokens=96)
+    prompts = {u: [u, u + 1, u + 2] for u in range(1, 7)}
+
+    # the reference: the same stub-engine arithmetic, per-tick — megastep
+    # plus replay must not change a single token
+    ref_eng, ref_ss = _stub_scheduler()
+    for u, p in prompts.items():
+        assert ref_ss.try_submit(u, p, samp).accepted
+    ref_ss.run()
+    want = {u: ref_ss.pop_result(u) for u in prompts}
+    ref_eng.close()
+
+    for u, p in prompts.items():
+        assert router.try_submit(u, p, samp).accepted
+    fused = 0
+    for _ in range(2):
+        router.tick()
+        fused = max([fused] + [w.last_burst_ticks for w in workers
+                               if w.alive])
+    # the wire really fused: some worker ran a multi-tick burst in ONE RPC
+    assert fused > 1, "no step_burst RPC ever covered more than one tick"
+    # FREEZE worker 1 mid-flight (mid-burst from the router's view: its
+    # step_burst RPC never completes) — death is DISCOVERED via the lease
+    srv1.shutdown()
+    out = router.run(max_ticks=4096)
+    stats = dict(router.stats)
+    assert stats["worker_deaths"] == 1
+    assert stats["discovered_deaths"] == 1
+    assert not workers[1].alive
+    assert all(out[u] == ("finished", want[u]) for u in prompts), (
+        "megastep replay diverged from the per-tick reference")
+    audits = router.close()
+    live_audits = [a for a in audits if a is not None]
+    assert live_audits and all(a["blocks_in_use"] == 0 for a in live_audits)
+
+
 def test_zero_workers_fails_tracked_requests_loudly(stub_server):
     srv = stub_server()
     cfg = RouterConfig(n_workers=1, heartbeat_interval_ms=10.0, lease_ms=100.0,
