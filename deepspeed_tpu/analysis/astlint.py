@@ -1,6 +1,6 @@
 """Source-level lint: AST passes over ``deepspeed_tpu``.
 
-Three rules, each guarding an invariant the runtime cannot check for
+Four rules, each guarding an invariant the runtime cannot check for
 itself:
 
 - **host-sync-in-hot-path** — ``jax.block_until_ready`` / ``device_get`` /
@@ -18,6 +18,14 @@ itself:
   universal.  Pre-qcomm training-side modules are grandfathered in
   :data:`LAX_COLLECTIVE_BASELINE`; serving-side code must route through
   ``comm.qcomm``.
+- **controller-import** — the online-adaptation controller
+  (``autotuning/controller.py``) runs on its own thread and MAY host-sync
+  (it is deliberately NOT in :data:`HOT_PATHS`); importing it from a
+  tick-path module (any file listed in HOT_PATHS) inverts that layering —
+  the serve loop must stay runnable with the controller package absent,
+  and coupling would invite tick code calling into a host-syncing,
+  lock-taking component.  The controller reaches the engine through the
+  scheduler's ``apply_knobs`` surface, never the other way around.
 
 A trailing ``# lint: allow(<rule>)`` comment on the offending line
 suppresses that line (for the rare measured-and-documented exception).
@@ -136,6 +144,11 @@ _LAX_COLLECTIVES = {
 _HOST_SYNC_ATTRS = {"block_until_ready", "item"}
 _HOST_SYNC_FUNCS = {"device_get"}
 
+# the adaptation controller's module path + its re-exported entry points:
+# either one imported from a HOT_PATHS module is a layering inversion
+_CONTROLLER_MODULE = "autotuning.controller"
+_CONTROLLER_NAMES = {"OnlineController", "attach_controller"}
+
 
 @dataclass(frozen=True)
 class LintViolation:
@@ -201,6 +214,37 @@ class _Visitor(ast.NodeVisitor):
                     f"raw lax.{node.attr} outside comm/ — route through "
                     "comm.qcomm so the fmt='none' A/B lever stays universal",
                 )
+        self.generic_visit(node)
+
+    # -- rule: controller import from a tick path ---------------------------
+    def _controller_import(self, node: ast.AST, what: str) -> None:
+        self._emit(
+            "controller-import", node,
+            f"tick-path module imports the adaptation controller ({what}) "
+            "— the controller thread may host-sync and is excluded from "
+            "HOT_PATHS precisely because nothing on the tick path may call "
+            "it; retunes flow controller -> scheduler.apply_knobs, never "
+            "the reverse",
+        )
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if self.hot_names is not None:
+            for alias in node.names:
+                if _CONTROLLER_MODULE in alias.name:
+                    self._controller_import(node, alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if self.hot_names is not None:
+            mod = node.module or ""
+            if _CONTROLLER_MODULE in mod:
+                self._controller_import(node, mod)
+            elif mod == "autotuning" or mod.endswith(".autotuning") \
+                    or (node.level > 0 and mod == "autotuning"):
+                hits = [a.name for a in node.names
+                        if a.name in _CONTROLLER_NAMES or a.name == "controller"]
+                if hits:
+                    self._controller_import(node, f"{mod}.{hits[0]}")
         self.generic_visit(node)
 
     # -- rule: host sync in hot paths --------------------------------------

@@ -642,6 +642,8 @@ class HostStubEngine:
         self.prefill_chunk = prefill_budget
         self.serve_replicas = 1
         self.enable_speculation = False
+        self.spec_max_draft = 4
+        self.kv_watermark = 0.0625
         self.faults = None
         self.mgr = _StubMgr(block_size, num_blocks, max_seqs)
         self._ns, self._sched_ns = self.telemetry.claim_prefixes(
@@ -703,6 +705,44 @@ class HostStubEngine:
 
     def plan_speculation(self, seqs, **kw) -> Dict[int, list]:
         return {}
+
+    def apply_knobs(self, *, enable_speculation=None, spec_max_draft=None,
+                    kv_watermark=None, prefill_chunk=None) -> Dict[str, Any]:
+        """Live-retune double: same validate-then-apply contract as the
+        real ``InferenceEngineV2.apply_knobs`` (including the spec-on
+        drain gate), so the retune-vs-tick scenario exercises the genuine
+        scheduler staging path."""
+        spec_on = (self.enable_speculation if enable_speculation is None
+                   else bool(enable_speculation))
+        draft = (self.spec_max_draft if spec_max_draft is None
+                 else int(spec_max_draft))
+        if spec_on and draft < 1:
+            raise ValueError("spec_max_draft must be >= 1 when speculating")
+        if spec_on and not self.enable_speculation \
+                and self.scheduler is not None and not self.scheduler.idle:
+            raise ValueError("enable_speculation can only turn on while "
+                             "the scheduler is drained")
+        if kv_watermark is not None \
+                and not 0.0 <= float(kv_watermark) < 1.0:
+            raise ValueError(f"kv_watermark must be in [0, 1), "
+                             f"got {kv_watermark}")
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
+        applied: Dict[str, Any] = {}
+        if enable_speculation is not None:
+            self.enable_speculation = bool(enable_speculation)
+            applied["enable_speculation"] = self.enable_speculation
+        if spec_max_draft is not None:
+            self.spec_max_draft = int(spec_max_draft)
+            applied["spec_max_draft"] = self.spec_max_draft
+        if kv_watermark is not None:
+            self.kv_watermark = float(kv_watermark)
+            applied["kv_watermark"] = self.kv_watermark
+        if prefill_chunk is not None:
+            self.prefill_chunk = int(prefill_chunk)
+            applied["prefill_chunk"] = self.prefill_chunk
+        return applied
 
     def close(self) -> Dict[str, int]:
         if not self._closed:
@@ -1262,6 +1302,124 @@ def scenario_cancel_during_megastep(seed: int, n_requests: int = 4) -> None:
             f"leak: {alloc.total_blocks - alloc.available_blocks} blocks")
 
 
+def scenario_retune_vs_tick(seed: int, n_requests: int = 4) -> None:
+    """The REAL :class:`~..autotuning.controller.OnlineController` on a
+    fake clock racing submit/decode-tick/megastep/cancel, plus direct
+    ``apply_knobs`` pushes (the router fan-out path) landing mid-flight.
+    Invariants: every engine dispatch within one tick observes a single
+    ``knob_epoch`` — staged retunes land only at the tick boundary, never
+    mid-burst; every accepted request still reaches exactly one terminal
+    state; invalid retunes are refused at the call site without poisoning
+    the staged batch; controller shutdown is idempotent; zero blocks
+    leak."""
+    from ..autotuning.controller import OnlineController
+    from ..config.config import AdaptationConfig, ServeConfig
+    from ..inference.sampling import SamplingParams
+    from ..inference.scheduler import TERMINAL
+    from ..telemetry import Telemetry
+
+    sched = Schedule(seed, max_preemptions=32)
+    with sched.instrument():
+        eng, ss = _stub_scheduler(telemetry=Telemetry(True),
+                                  serve=ServeConfig(decode_megastep=2))
+        clock = [0.0]
+        ctl = OnlineController(
+            ss, config=AdaptationConfig(enabled=True, epoch_s=0.01,
+                                        min_window=1, guard_epochs=1,
+                                        allow_rebuild=False),
+            telemetry=eng.telemetry, serve_ns=eng._ns,
+            prefill_budget=eng.prefill_budget, clock=lambda: clock[0])
+        accepted: List[int] = []
+        # every dispatch a tick makes must see the SAME knob epoch: record
+        # the epoch at each engine entry point, keyed by tick number
+        seen_epochs: Dict[int, set] = {}
+
+        def _observe() -> None:
+            seen_epochs.setdefault(ss.tick_no, set()).add(ss.knob_epoch)
+
+        for _name in ("prefill_entries", "_decode_tick", "_decode_burst"):
+            def _wrap(fn=getattr(eng, _name)):
+                def inner(*a, **k):
+                    _observe()
+                    return fn(*a, **k)
+                return inner
+            setattr(eng, _name, _wrap())
+
+        def submitter() -> None:
+            for i in range(n_requests):
+                res = ss.try_submit(
+                    400 + i, [1, 2, 3],
+                    SamplingParams(temperature=0.0, max_new_tokens=6))
+                if res.accepted:
+                    accepted.append(400 + i)
+
+        def ticker() -> None:
+            for _ in range(10):
+                clock[0] += 0.05  # the fake clock advances with the ticks
+                ss.tick()
+
+        def retuner() -> None:
+            # the router fan-out push path: direct staged batches racing
+            # the owner tick AND the controller's own epochs
+            ss.apply_knobs(decode_megastep=4)
+            checkpoint()
+            ss.apply_knobs(prefill_chunk=8, kv_watermark=0.125)
+            checkpoint()
+            try:
+                ss.apply_knobs(decode_megastep=0)
+            except ValueError:
+                pass  # refused at validation, batch untouched
+            else:
+                raise AssertionError("decode_megastep=0 must be refused")
+            try:
+                ss.apply_knobs(nonsense_knob=1)
+            except ValueError:
+                pass
+            else:
+                raise AssertionError("unknown knob must be refused")
+
+        def adapt() -> None:
+            ctl.start()
+            ctl.start()  # idempotent while running
+            checkpoint()
+            clock[0] += 0.05
+            checkpoint()
+            ctl.stop()
+            ctl.stop()  # idempotent after shutdown
+
+        def canceller() -> None:
+            ss.cancel(401)
+            ss.cancel(999)  # unknown uid: quiet no-op
+
+        sched.spawn(submitter, name="submit")
+        sched.spawn(ticker, name="tick")
+        sched.spawn(retuner, name="retune")
+        sched.spawn(adapt, name="adapt")
+        sched.spawn(canceller, name="cancel")
+        sched.run()
+
+        for _ in range(64):  # drain on the owner thread
+            if all(ss.requests[u].state in TERMINAL for u in accepted):
+                break
+            clock[0] += 0.05
+            ss.tick()
+        ss.tick()  # flush any batch staged after the last drain tick
+        states = {u: ss.requests[u].state for u in accepted}
+        assert all(s in TERMINAL for s in states.values()), states
+        # the staging contract: no tick ever dispatched under two epochs
+        mixed = {t: e for t, e in seen_epochs.items() if len(e) != 1}
+        assert not mixed, f"knob epoch changed mid-tick: {mixed}"
+        assert ss._staged_knobs is None, ss._staged_knobs
+        assert ss.last_knob_error is None, ss.last_knob_error
+        assert ctl._thread is None  # shutdown actually landed
+        assert ctl.last_error is None, ctl.last_error
+        for d in ctl.decisions:  # every decision carries its evidence
+            assert "action" in d and "outcome" in d and "signals" in d, d
+        alloc = eng.mgr.allocator
+        assert alloc.available_blocks == alloc.total_blocks, (
+            f"leak: {alloc.total_blocks - alloc.available_blocks} blocks")
+
+
 SCENARIOS = (
     scenario_namespace_claims,
     scenario_submit_tick_cancel,
@@ -1270,6 +1428,7 @@ SCENARIOS = (
     scenario_replica_affine_admission,
     scenario_heartbeat_expiry_vs_route,
     scenario_cancel_during_megastep,
+    scenario_retune_vs_tick,
 )
 
 

@@ -8,6 +8,11 @@ from .autotuner import (  # noqa: F401
     leaderboard,
     write_leaderboard,
 )
+from .controller import (  # noqa: F401
+    OnlineController,
+    attach_controller,
+    roofline_rebuild_scorer,
+)
 from .roofline import RooflineConstants  # noqa: F401
 from .space import Knob, SearchSpace, serving_space, training_space  # noqa: F401
 from .trial import ServeTrialRunner, ServeWorkload, TrainTrialRunner  # noqa: F401

@@ -178,6 +178,9 @@ def serving_feasible(cand: Dict[str, Any], model_cfg, base: Dict[str, Any],
             return False, "structural: max_seqs/num_blocks must divide replicas"
     if cand.get("quant_comm", "none") != "none" and tp <= 1:
         return False, "structural: quant_comm needs a TP mesh"
+    megastep = cand.get("decode_megastep", 1)
+    if megastep is not None and int(megastep) < 1:
+        return False, "structural: decode_megastep must be >= 1"
     consts = consts or RooflineConstants()
     need = (weight_stream_bytes(model_cfg, cand.get("quant")) / tp
             + kv_pool_bytes(model_cfg, base.get("num_blocks", 0),
@@ -213,7 +216,11 @@ def predict_serve_cost(cand: Dict[str, Any], model_cfg,
             sample_rows=B, compute_itemsize=2,
         )
         t += plan_bytes(plan) / (consts.ici_gbps * 1e9)
-    t += consts.host_tick_s
+    # megastep fuses n decode ticks into ONE device burst (one host sync),
+    # amortizing the host dispatch across the fused ticks; the device time
+    # per tick is unchanged.  _canon_serving pins megastep to 1 under spec
+    # (the scheduler collapses it there), so no interaction term is needed.
+    t += consts.host_tick_s / max(int(cand.get("decode_megastep", 1) or 1), 1)
     emitted = float(B)
     if cand.get("spec"):
         # prompt-lookup acceptance on mixed workloads lands ~0.3; each

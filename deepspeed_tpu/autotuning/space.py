@@ -121,6 +121,13 @@ def _canon_serving(cand: Dict[str, Any]) -> Dict[str, Any]:
         c["comm_tiles"] = 1
     if c.get("quant_comm", "none") == "none":
         c["comm_tiles"] = 1  # tiling only splits the quantized transport
+    # pre-megastep candidate dicts (hand-tuned incumbents) canonicalize
+    # onto the per-tick grid row, so candidate_key stays comparable
+    c.setdefault("decode_megastep", 1)
+    if c.get("spec", False):
+        # the scheduler collapses a megastep to per-tick whenever live
+        # speculation proposals exist, so the knob is inert under spec
+        c["decode_megastep"] = 1
     return c
 
 
@@ -135,6 +142,7 @@ def serving_space(
     quant_comm: Sequence[str] = ("none", "int8"),
     comm_tiles: Sequence[int] = (1,),
     prefix_caching: Sequence[bool] = (True,),
+    decode_megastep: Sequence[int] = (1, 4),
 ) -> SearchSpace:
     """Serving search space over the engine/scheduler knobs accumulated
     since PR 2.  Values mirror the ``InferenceEngineV2`` constructor
@@ -158,6 +166,7 @@ def serving_space(
             Knob("spec_max_draft", tuple(spec_max_draft)),
             Knob("quant_comm", tuple(quant_comm)),
             Knob("comm_tiles", tuple(comm_tiles)),
+            Knob("decode_megastep", tuple(decode_megastep)),
         ],
         canonicalize=_canon_serving,
     )

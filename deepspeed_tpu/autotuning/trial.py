@@ -94,7 +94,16 @@ class ServeTrialRunner:
                 kw[f] = cand[k]
         if not kw.get("enable_speculation"):
             kw.pop("spec_max_draft", None)
+        # decode_megastep is a ServeConfig (scheduler-tier) knob, not an
+        # engine-shape field — it routes via the serve= block at build time
+        kw.pop("decode_megastep", None)
         return _coerce(ServeEngineConfig, kw)
+
+    def serve_config(self, cand: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """ServeConfig overrides carried by the candidate (the scheduler-
+        tier knobs the engine shape does not own)."""
+        n = int(cand.get("decode_megastep", 1) or 1)
+        return {"decode_megastep": n} if n > 1 else None
 
     def _drive(self, sched, prompts, samp, uid_off: int, arrivals):
         steps = sched.tick_no + np.cumsum(arrivals)
@@ -119,6 +128,7 @@ class ServeTrialRunner:
         tel = (self.telemetry_factory() if self.telemetry_factory is not None
                else Telemetry(True))
         eng = build_serve_engine(self.params, cfg, sec, telemetry=tel,
+                                 serve=self.serve_config(cand),
                                  devices=self.devices)
         try:
             sched = eng.scheduler

@@ -202,6 +202,61 @@ class TelemetryConfig:
 
 
 @dataclass
+class AdaptationConfig:
+    """Online-autotuning controller knobs (``autotuning/controller.py``).
+
+    The controller samples the live telemetry registry every
+    ``epoch_s`` seconds (windowed TTFT/TBT percentiles, spec accept-rate,
+    queue depth, pool headroom, ``comm/bytes_on_wire``) and retunes the
+    live-tier knobs (``prefill_chunk``, ``kv_watermark``,
+    ``spec_max_draft``, shed thresholds, ``decode_megastep``) through
+    ``ServeScheduler.apply_knobs``.  Every retune opens ``guard_epochs``
+    A/B guard epochs: if the SLO percentile the change was meant to
+    improve regresses by more than ``regress_tolerance`` (ratio), the
+    change rolls back and the knob enters ``cooldown_epochs`` of
+    cooldown.  Rebuild-tier knobs (tp / serve_replicas / weight quant /
+    ``quant_comm`` — frozen into compiled programs) are only PROPOSED,
+    and only when the roofline-predicted win clears ``rebuild_hysteresis``;
+    the engine's single-owner thread executes the rebuild
+    (``engine.close()`` + ``build_serve_engine``), never the controller
+    thread."""
+
+    enabled: bool = False
+    epoch_s: float = 0.25
+    min_window: int = 4  # min windowed samples before any decision
+    guard_epochs: int = 2
+    regress_tolerance: float = 1.15  # guard metric ratio that triggers rollback
+    cooldown_epochs: int = 4
+    rebuild_hysteresis: float = 1.25  # predicted-cost ratio gating a rebuild proposal
+    allow_rebuild: bool = True
+    # SLO targets the retune heuristics steer toward (None = throughput-only)
+    ttft_slo_ms: Optional[float] = None
+    tbt_slo_ms: Optional[float] = None
+    max_decode_megastep: int = 8
+    max_spec_draft: int = 8
+
+    def __post_init__(self):
+        if self.epoch_s <= 0:
+            raise ConfigError(
+                f"adaptation.epoch_s must be positive, got {self.epoch_s}")
+        for k in ("min_window", "guard_epochs", "cooldown_epochs",
+                  "max_decode_megastep", "max_spec_draft"):
+            if int(getattr(self, k)) < 1:
+                raise ConfigError(
+                    f"adaptation.{k} must be >= 1, got {getattr(self, k)}")
+        for k in ("regress_tolerance", "rebuild_hysteresis"):
+            if getattr(self, k) < 1.0:
+                raise ConfigError(
+                    f"adaptation.{k} must be >= 1.0 (a ratio), got "
+                    f"{getattr(self, k)}")
+        for k in ("ttft_slo_ms", "tbt_slo_ms"):
+            v = getattr(self, k)
+            if v is not None and v <= 0:
+                raise ConfigError(
+                    f"adaptation.{k} must be positive or None, got {v}")
+
+
+@dataclass
 class ServeConfig:
     """Fault-tolerant-serving knobs (inference/scheduler.py lifecycle layer).
     Consumed by ``InferenceEngineV2(serve=...)`` / ``ServeScheduler`` — the
@@ -253,8 +308,15 @@ class ServeConfig:
     # run at megastep BOUNDARIES, so the reaction latency bound grows to
     # decode_megastep x per-tick duration.
     decode_megastep: int = 1
+    # online autotuning (autotuning/controller.py): the telemetry-driven
+    # controller that retunes the live-tier knobs under traffic drift.
+    # Off by default — enabled=False is token-identical to no controller
+    # (nothing samples, nothing retunes).
+    adaptation: AdaptationConfig = field(default_factory=AdaptationConfig)
 
     def __post_init__(self):
+        if not isinstance(self.adaptation, AdaptationConfig):
+            self.adaptation = _coerce(AdaptationConfig, self.adaptation)
         if self.quant_comm not in ("none", "int8", "fp8"):
             raise ConfigError(
                 f"serve.quant_comm must be one of 'none'|'int8'|'fp8', "

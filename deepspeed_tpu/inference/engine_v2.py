@@ -12,7 +12,7 @@ Dynamic-SplitFuse-style fixed token budget replaced by one-prefill-per-put
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1782,6 +1782,55 @@ class InferenceEngineV2:
             reg.gauge(f"{pre}/spec_accept_rate").set(row["spec_accept_rate"])
 
     # -- teardown -----------------------------------------------------------
+    # -- live retune surface -------------------------------------------------
+    def apply_knobs(self, *, enable_speculation: Optional[bool] = None,
+                    spec_max_draft: Optional[int] = None,
+                    kv_watermark: Optional[float] = None,
+                    prefill_chunk: Optional[int] = None) -> Dict[str, Any]:
+        """Retune the engine-owned LIVE knobs — the ones read per tick off
+        plain attributes, never baked into a compiled program — validated
+        against the same gates as construction.  Raises ``ValueError`` on
+        any invalid value BEFORE applying anything (all-or-nothing).
+        Everything else (tp, replicas, weight quant, ``quant_comm``,
+        ``comm_tiles``, pool geometry) is frozen into the jits /
+        ``ServingContext`` and can only change through a rebuild
+        (``close()`` + ``build_serve_engine``).  Returns the applied
+        ``{knob: value}``.  Call from the engine's single-owner thread
+        (the scheduler applies staged knobs at its tick boundary)."""
+        spec_on = (self.enable_speculation if enable_speculation is None
+                   else bool(enable_speculation))
+        draft = (self.spec_max_draft if spec_max_draft is None
+                 else int(spec_max_draft))
+        if spec_on and draft < 1:
+            raise ValueError("spec_max_draft must be >= 1 when speculating")
+        if spec_on and not self.enable_speculation \
+                and self._scheduler is not None and not self._scheduler.idle:
+            # turning the drafter ON mid-flight would hand live sequences
+            # drafter state they were never admitted with; require a drain
+            raise ValueError(
+                "enable_speculation can only turn on while the scheduler "
+                "is drained (live sequences carry no drafter state)")
+        if kv_watermark is not None and not 0.0 <= float(kv_watermark) < 1.0:
+            raise ValueError(
+                f"kv_watermark must be in [0, 1), got {kv_watermark}")
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        applied: Dict[str, Any] = {}
+        if enable_speculation is not None:
+            self.enable_speculation = spec_on
+            applied["enable_speculation"] = spec_on
+        if spec_max_draft is not None:
+            self.spec_max_draft = draft
+            applied["spec_max_draft"] = draft
+        if kv_watermark is not None:
+            self.kv_watermark = float(kv_watermark)
+            applied["kv_watermark"] = self.kv_watermark
+        if prefill_chunk is not None:
+            self.prefill_chunk = int(prefill_chunk)
+            applied["prefill_chunk"] = self.prefill_chunk
+        return applied
+
     def close(self) -> Dict[str, int]:
         """Tear this engine down so another can be built in-process without
         inheriting its footprint (the autotuner runs trial engines

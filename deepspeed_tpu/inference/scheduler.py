@@ -83,7 +83,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..config.config import ServeConfig, _coerce
@@ -185,6 +185,7 @@ class ServeScheduler:
         # unusable to it
         total = engine.mgr.allocator.total_blocks // engine.mgr.replicas
         self._watermark_blocks = max(1, round(total * kv_watermark))
+        self.kv_watermark = float(kv_watermark)
         self.starvation_ticks = starvation_ticks
         self.serve: ServeConfig = serve if isinstance(serve, ServeConfig) \
             else _coerce(ServeConfig, serve)
@@ -205,6 +206,13 @@ class ServeScheduler:
         # expire phase instead of freeing a descriptor the in-flight
         # dispatch still indexes
         self._in_tick = False
+        # live-retune staging: ``apply_knobs`` validates and parks the new
+        # values here under the intake lock; the tick pops + applies them at
+        # its own boundary, so no dispatch phase ever observes a knob change
+        # mid-burst (the invariant scenario_retune_vs_tick replays)
+        self._staged_knobs: Optional[Dict[str, Any]] = None
+        self.knob_epoch = 0  # bumps once per applied retune batch
+        self.last_knob_error: Optional[str] = None
         # terminal trace events recorded under the intake lock, fired
         # OUTSIDE it by _flush_released: trace.finished writes the JSONL
         # request summary, and disk I/O must never ride the intake lock
@@ -243,6 +251,8 @@ class ServeScheduler:
             "drafts_shed",  # draft sets dropped under pool pressure
             "migrated",  # requests detached to another worker (KV handoff)
             "adopted",  # requests adopted mid-flight (the receiving side)
+            "retunes",  # knob batches applied at a tick boundary
+            "retune_rejects",  # staged batches refused at apply time
         ))
         self._tick_ms_ema: Optional[float] = None  # retry_after_ms basis
         # decode ticks fused into this tick's device burst (megastep): 1 =
@@ -1295,6 +1305,145 @@ class ServeScheduler:
         request) awaiting ``pop_result``."""
         return [u for u, r in self.requests.items() if r.state == FAILED]
 
+    # -- live retune surface ------------------------------------------------
+    # knob tiers: everything listed here retunes WITHOUT a rebuild — serve
+    # knobs swap the ServeConfig the tick phases read, engine knobs go
+    # through ``engine.apply_knobs`` (host-side attributes the dispatch
+    # plumbing reads fresh each tick).  Anything frozen into compiled
+    # programs or the ServingContext (tp, serve_replicas, quantize_weights,
+    # quant_comm, comm_tiles) is REBUILD tier: close() + build_serve_engine.
+    _SERVE_KNOBS = frozenset((
+        "decode_megastep", "shed_queue_depth", "watchdog_tick_ms",
+        "watchdog_grace_ticks", "deadline_ms", "ttft_deadline_ms",
+    ))
+    _ENGINE_KNOBS = frozenset((
+        "prefill_chunk", "kv_watermark", "spec_max_draft",
+        "enable_speculation",
+    ))
+
+    def apply_knobs(self, **knobs: Any) -> Dict[str, Any]:
+        """Stage a validated live-retune batch; it takes effect at the NEXT
+        tick boundary.  Safe from any thread (the controller's entry point
+        into the engine): validation runs eagerly so the caller gets a
+        typed ``ValueError`` for an impossible value, but the swap itself
+        is deferred to ``tick()`` — the single-owner dispatch loop never
+        observes a knob change between its phases.  Repeated calls between
+        ticks merge (later values win).  Returns the staged dict."""
+        unknown = set(knobs) - self._SERVE_KNOBS - self._ENGINE_KNOBS
+        if unknown:
+            raise ValueError(
+                f"unknown live knobs {sorted(unknown)}; live tier is "
+                f"{sorted(self._SERVE_KNOBS | self._ENGINE_KNOBS)} — "
+                "anything else needs an engine rebuild")
+        if not knobs:
+            return {}
+        serve_kw = {k: v for k, v in knobs.items() if k in self._SERVE_KNOBS}
+        if serve_kw:
+            replace(self.serve, **serve_kw)  # ConfigError (a ValueError) on bad values
+        if "prefill_chunk" in knobs and int(knobs["prefill_chunk"]) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {knobs['prefill_chunk']}")
+        if "kv_watermark" in knobs \
+                and not 0.0 <= float(knobs["kv_watermark"]) < 1.0:
+            raise ValueError(
+                f"kv_watermark must be in [0, 1), got {knobs['kv_watermark']}")
+        if "spec_max_draft" in knobs and int(knobs["spec_max_draft"]) < 1:
+            raise ValueError(
+                f"spec_max_draft must be >= 1, got {knobs['spec_max_draft']}")
+        with self._lock:
+            staged = dict(self._staged_knobs or ())
+            staged.update(knobs)
+            self._staged_knobs = staged
+            return dict(staged)
+
+    def _apply_pending_knobs(self) -> None:
+        """Tick-boundary application of a staged retune batch.  Runs on the
+        owner tick thread before any phase looks at scheduling state; the
+        whole swap happens under the intake lock and is pure host math (no
+        device work, no blocking calls).  A batch the engine refuses at
+        apply time (e.g. speculation turning on while sequences are live)
+        is dropped whole and recorded — a mid-tick raise would kill the
+        serve loop over a controller's stale guess."""
+        with self._lock:
+            staged, self._staged_knobs = self._staged_knobs, None
+            if not staged:
+                return
+            try:
+                self._apply_knobs_locked(staged)
+                self.knob_epoch += 1
+                self.last_knob_error = None
+                self._c["retunes"].inc()
+            except ValueError as e:
+                self.last_knob_error = str(e)
+                self._c["retune_rejects"].inc()
+
+    def _apply_knobs_locked(self, staged: Dict[str, Any]) -> None:
+        eng_kw = {k: staged[k] for k in self._ENGINE_KNOBS if k in staged}
+        if eng_kw:
+            # all-or-nothing inside the engine; raises before mutating
+            self.engine.apply_knobs(**eng_kw)
+        serve_kw = {k: staged[k] for k in self._SERVE_KNOBS if k in staged}
+        if serve_kw:
+            self.serve = replace(self.serve, **serve_kw)
+        if "prefill_chunk" in staged:
+            bs = self.engine.block_size
+            chunk = min(int(staged["prefill_chunk"]),
+                        self.engine.prefill_budget)
+            self.prefill_chunk = max(bs, (chunk // bs) * bs)
+        if "kv_watermark" in staged:
+            self.kv_watermark = float(staged["kv_watermark"])
+            total = self.engine.mgr.allocator.total_blocks \
+                // self.engine.mgr.replicas
+            self._watermark_blocks = max(1, round(total * self.kv_watermark))
+
+    def knobs(self) -> Dict[str, Any]:
+        """Current EFFECTIVE live-tier knob values (staged-but-unapplied
+        batches are not reflected — they land at the next tick)."""
+        eng = self.engine
+        with self._lock:
+            return {
+                "prefill_chunk": self.prefill_chunk,
+                "kv_watermark": self.kv_watermark,
+                "enable_speculation": bool(eng.enable_speculation),
+                "spec_max_draft": int(eng.spec_max_draft),
+                "decode_megastep": self.serve.decode_megastep,
+                "shed_queue_depth": self.serve.shed_queue_depth,
+                "watchdog_tick_ms": self.serve.watchdog_tick_ms,
+                "watchdog_grace_ticks": self.serve.watchdog_grace_ticks,
+                "deadline_ms": self.serve.deadline_ms,
+                "ttft_deadline_ms": self.serve.ttft_deadline_ms,
+                "knob_epoch": self.knob_epoch,
+            }
+
+    def signals(self) -> Dict[str, Any]:
+        """Host-only load snapshot for the adaptation controller: queue and
+        pool pressure the registry's counters cannot express as state.
+        Reads scheduler fields under the intake lock and the allocator's
+        host-side accounting — no device sync, no dispatch state, so it is
+        safe from the controller thread at any time."""
+        mgr = self.engine.mgr
+        alloc = mgr.allocator
+        free, total = alloc.available_blocks, alloc.total_blocks
+        pt, ct = mgr.prompt_tokens_total, mgr.cached_prompt_tokens
+        with self._lock:
+            return {
+                "tick_no": self.tick_no,
+                "prompt_tokens_total": pt,
+                "cached_prompt_tokens": ct,
+                "prefix_hit_rate": (ct / pt) if pt else 0.0,
+                "preemptions": self._c["preemptions"].value,
+                "queue_depth": len(self.waiting),
+                "running": len(self._running),
+                "shedding": self._shed,
+                "tick_ms_ema": self._tick_ms_ema,
+                "free_blocks": free,
+                "total_blocks": total,
+                "watermark_blocks": self._watermark_blocks,
+                "headroom_fraction": free / total if total else 0.0,
+                "knob_epoch": self.knob_epoch,
+                "last_knob_error": self.last_knob_error,
+            }
+
     # -- the loop -----------------------------------------------------------
     @property
     def idle(self) -> bool:
@@ -1309,6 +1458,7 @@ class ServeScheduler:
         read their terminal state off ``requests[uid]``."""
         self.tick_no += 1
         self._in_tick = True  # single-owner write: cancels now defer
+        self._apply_pending_knobs()  # staged retunes land HERE, never mid-phase
         t0 = self._clock()  # BEFORE the fault delay: an injected stall must
         # land inside the watchdog's measured window or it cannot trip it
         try:

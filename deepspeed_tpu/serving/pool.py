@@ -174,6 +174,12 @@ class Worker:
     def retry_after_ms(self) -> float:
         return self.scheduler.retry_after_ms()
 
+    def apply_knobs(self, knobs: Dict[str, Any]) -> Dict[str, Any]:
+        """Stage a live-retune batch on this worker's scheduler (applied at
+        its next tick boundary) — the in-process leg of the controller's
+        per-worker knob push."""
+        return self.scheduler.apply_knobs(**knobs)
+
     # -- the KV-handoff surface ----------------------------------------------
     def extract_handoff(self, uid: int, fmt: str) -> handoff_mod.KVHandoff:
         return handoff_mod.extract_request(self.engine, uid, fmt=fmt)

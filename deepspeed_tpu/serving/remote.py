@@ -502,6 +502,20 @@ class RemoteWorker:
             return {}
         return {"serve": reply.get("serve", {}), "sched": reply.get("sched", {})}
 
+    def apply_knobs(self, knobs: Dict[str, Any]) -> Dict[str, Any]:
+        """Push a live-retune batch to the worker process (staged on its
+        scheduler, applied at its next tick).  A validation refusal comes
+        back as the typed error reply and raises ``ValueError`` — the same
+        contract as the in-process worker; a dead worker raises
+        ``WorkerDead`` for the router's condemnation path."""
+        reply = self._call({"op": "apply_knobs", "knobs": dict(knobs)})
+        if not reply.get("ok"):
+            err = reply.get("error") or {}
+            raise ValueError(
+                f"apply_knobs refused on worker {self.index}: "
+                f"{err.get('detail')}")
+        return dict(reply.get("staged") or {})
+
     # -- load signals (from the latest tick/op reply) ------------------------
     @property
     def ns(self) -> str:

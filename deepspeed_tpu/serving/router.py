@@ -48,7 +48,7 @@ from __future__ import annotations
 
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..config.config import RouterConfig, _coerce
 from ..inference import scheduler as sched_mod
@@ -65,6 +65,7 @@ from ..inference.scheduler import (
 )
 from ..telemetry import StatsView
 from .pool import MIXED_ROLE, WorkerPool
+from .transport import WorkerDead
 
 BACKLOG, SUBMITTED, DONE = "backlog", "submitted", "done"
 
@@ -563,6 +564,21 @@ class Router:
             ticks += 1
         uids = wait_for if wait_for is not None else list(self._results)
         return {u: (self._results[u][0], self._results[u][1]) for u in uids}
+
+    def apply_knobs(self, knobs: Dict[str, Any]) -> Dict[int, Any]:
+        """Push one live-retune batch to EVERY live worker (the fan-out leg
+        of the adaptation controller).  Per-worker failures are isolated:
+        a validation refusal or a dead worker records an error entry for
+        that index and the push continues — a retune must never be able to
+        take the pool down.  Returns {worker index: staged dict | error
+        string}."""
+        out: Dict[int, Any] = {}
+        for w in list(self.pool.alive):
+            try:
+                out[w.index] = w.apply_knobs(dict(knobs))
+            except (ValueError, WorkerDead) as e:
+                out[w.index] = f"{type(e).__name__}: {e}"
+        return out
 
     # -- teardown ------------------------------------------------------------
     def prefix_hit_rate(self) -> float:

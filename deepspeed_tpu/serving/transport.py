@@ -1362,6 +1362,14 @@ class WorkerServer:
         return {"serve": dict(self.engine.stats),
                 "sched": dict(self.scheduler.stats)}
 
+    def _op_apply_knobs(self, op, blobs):
+        """Stage a live-retune batch on this worker's scheduler (the wire
+        leg of the controller's per-worker knob push).  Validation errors
+        surface as the typed error reply like any other bad op; the staged
+        values land at the worker's next tick boundary."""
+        staged = self.scheduler.apply_knobs(**dict(op.get("knobs") or {}))
+        return {"staged": staged, "knobs": self.scheduler.knobs()}
+
     def _op_close(self, op, blobs):
         self.close_audit = self.engine.close()
         self._running = False

@@ -15,9 +15,11 @@ from .registry import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    RateView,
     StatsView,
     format_percentile_table,
     percentile_summary,
+    window_percentile_summary,
 )
 from .tracing import (  # noqa: F401
     NULL_REQUEST_TRACE,

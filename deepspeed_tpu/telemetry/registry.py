@@ -46,6 +46,7 @@ import json
 import math
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Iterator, List, MutableMapping, Optional, Sequence, Tuple
 
 Event = Tuple[str, float, int]
@@ -113,14 +114,24 @@ class Histogram:
     ``exact_limit`` observations; past that the raw list is dropped and
     quantiles interpolate the geometric midpoint of the covering bucket,
     clamped to the observed [min, max].
+
+    Alongside the lifetime-cumulative store, a bounded ring of the most
+    recent ``window_limit`` samples backs the ``window_*`` views — the
+    drift-detection surface the online autotuning controller samples each
+    epoch (a lifetime p90 over an hour of traffic cannot see a
+    five-minute-old phase shift) and the steady-state percentile tables
+    the bench reports.  The ring is always exact (nearest-rank over the
+    retained samples) and survives the ``exact_limit`` degradation of the
+    cumulative store.
     """
 
     __slots__ = ("name", "_lock", "_lo", "_log_lo", "_log_g", "_growth",
                  "_counts", "_samples", "_sorted", "count", "sum",
-                 "_min", "_max", "exact_limit")
+                 "_min", "_max", "exact_limit", "_window")
 
     def __init__(self, name: str, lo: float = 1e-3, hi: float = 1e7,
-                 growth: float = 2.0 ** 0.25, exact_limit: int = 4096):
+                 growth: float = 2.0 ** 0.25, exact_limit: int = 4096,
+                 window_limit: int = 512):
         if not (lo > 0 and hi > lo and growth > 1):
             raise ValueError(f"bad histogram bounds lo={lo} hi={hi} growth={growth}")
         self.name = name
@@ -138,6 +149,7 @@ class Histogram:
         self._min = math.inf
         self._max = -math.inf
         self.exact_limit = exact_limit
+        self._window: "deque[float]" = deque(maxlen=max(2, int(window_limit)))
 
     def _bucket_of(self, v: float) -> int:
         if v <= self._lo:
@@ -158,6 +170,7 @@ class Histogram:
             if v > self._max:
                 self._max = v
             self._counts[self._bucket_of(v)] += 1
+            self._window.append(v)
             if self._samples is not None:
                 self._samples.append(v)
                 self._sorted = None
@@ -206,6 +219,40 @@ class Histogram:
         return {f"p{int(q) if float(q).is_integer() else q}": self.percentile(q)
                 for q in qs}
 
+    # -- windowed views (ring of recent samples; drift detection) ----------
+    @property
+    def window_count(self) -> int:
+        return len(self._window)
+
+    def window_percentile(self, q: float) -> float:
+        """Nearest-rank percentile over ONLY the most recent
+        ``window_limit`` samples — always exact; 0.0 on an empty ring."""
+        with self._lock:
+            n = len(self._window)
+            if n == 0:
+                return 0.0
+            rank = min(n, max(1, math.ceil(q / 100.0 * n)))
+            return sorted(self._window)[rank - 1]
+
+    def window_quantiles(self, qs: Sequence[float] = (50, 90, 99),
+                         ) -> Dict[str, float]:
+        with self._lock:
+            ordered = sorted(self._window)
+        n = len(ordered)
+        out: Dict[str, float] = {}
+        for q in qs:
+            key = f"p{int(q) if float(q).is_integer() else q}"
+            if n == 0:
+                out[key] = 0.0
+            else:
+                out[key] = ordered[min(n, max(1, math.ceil(q / 100.0 * n))) - 1]
+        return out
+
+    def window_mean(self) -> float:
+        with self._lock:
+            return (sum(self._window) / len(self._window)
+                    if self._window else 0.0)
+
     def reset(self) -> None:
         """Drop every observation (bench: discard the warmup/compile window
         so percentiles describe only the measured run)."""
@@ -217,6 +264,7 @@ class Histogram:
             self.sum = 0.0
             self._min = math.inf
             self._max = -math.inf
+            self._window.clear()
 
     def __repr__(self) -> str:
         return (f"Histogram({self.name}: n={self.count} mean={self.mean:.4g} "
@@ -255,6 +303,7 @@ class _NullHistogram:
     min = 0.0
     max = 0.0
     exact = True
+    window_count = 0
 
     def observe(self, v) -> None:
         pass
@@ -265,6 +314,15 @@ class _NullHistogram:
     def quantiles(self, qs: Sequence[float] = (50, 90, 99)) -> Dict[str, float]:
         return {f"p{int(q) if float(q).is_integer() else q}": 0.0 for q in qs}
 
+    def window_percentile(self, q: float) -> float:
+        return 0.0
+
+    def window_quantiles(self, qs: Sequence[float] = (50, 90, 99)) -> Dict[str, float]:
+        return {f"p{int(q) if float(q).is_integer() else q}": 0.0 for q in qs}
+
+    def window_mean(self) -> float:
+        return 0.0
+
     def reset(self) -> None:
         pass
 
@@ -272,6 +330,51 @@ class _NullHistogram:
 NULL_COUNTER = _NullCounter()
 NULL_GAUGE = _NullGauge()
 NULL_HISTOGRAM = _NullHistogram()
+
+
+class RateView:
+    """Windowed rate over a cumulative counter: a ring of recent
+    ``(time, value)`` samples turns a lifetime total into the signal drift
+    detection actually needs — the recent first derivative.  ``sample(now)``
+    appends one observation and returns the rate (units/second) across the
+    ring's span; the first sample returns 0.0 (no span yet).  Counter
+    resets (value going backwards, e.g. an engine rebuild re-registering
+    fresh counters) restart the ring instead of reporting a negative rate.
+
+    Works over anything with a numeric ``.value`` (Counter, Gauge, or a
+    null singleton — the disabled path stays a cheap no-op that always
+    reads 0.0).
+    """
+
+    __slots__ = ("source", "_lock", "_ring")
+
+    def __init__(self, source, window: int = 8):
+        self.source = source
+        self._lock = threading.Lock()
+        self._ring: "deque[Tuple[float, float]]" = deque(
+            maxlen=max(2, int(window)))
+
+    def sample(self, now: float) -> float:
+        v = float(self.source.value)
+        with self._lock:
+            if self._ring and v < self._ring[-1][1]:
+                self._ring.clear()  # counter reset: restart the window
+            self._ring.append((float(now), v))
+            t0, v0 = self._ring[0]
+            t1, v1 = self._ring[-1]
+        dt = t1 - t0
+        return (v1 - v0) / dt if dt > 0 else 0.0
+
+    def delta(self) -> float:
+        """Value change across the current ring (no new sample taken)."""
+        with self._lock:
+            if len(self._ring) < 2:
+                return 0.0
+            return self._ring[-1][1] - self._ring[0][1]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
 
 
 class MetricsRegistry:
@@ -502,6 +605,25 @@ def percentile_summary(
             continue
         row = {"count": float(h.count), "mean": h.mean}
         row.update(h.quantiles(qs))
+        out[name.rsplit("/", 1)[-1]] = row
+    return out
+
+
+def window_percentile_summary(
+    registry: MetricsRegistry,
+    names: Sequence[str],
+    qs: Sequence[float] = (50, 90, 99),
+) -> Dict[str, Dict[str, float]]:
+    """``percentile_summary`` over the WINDOWED views: quantiles of only
+    each histogram's recent-sample ring (steady-state tables, controller
+    epoch snapshots).  Absent/empty-window histograms are skipped."""
+    out: Dict[str, Dict[str, float]] = {}
+    for name in names:
+        h = registry.get(name)
+        if h is None or not isinstance(h, Histogram) or h.window_count == 0:
+            continue
+        row = {"count": float(h.window_count), "mean": h.window_mean()}
+        row.update(h.window_quantiles(qs))
         out[name.rsplit("/", 1)[-1]] = row
     return out
 
