@@ -1275,6 +1275,181 @@ def megastep_serve_main(smoke: bool = False, quant=None, megastep=None):
     return payload
 
 
+def longctx_serve_main(smoke: bool = False, quant=None):
+    """Sequence-sharded long-context A/B twin (`python bench.py --serving
+    --longctx [--smoke] [--quant int8]`): the paged-KV pool striped over a
+    ``seq`` mesh axis (``seq_shards=2``, ring-combined partial attention)
+    vs a single-pool engine, in two gated phases —
+
+    * **fits-either** — the SAME shared-prefix arrival workload served by
+      both twins at equal AGGREGATE pool budget: asserts the seq-sharded
+      engine is greedy TOKEN-IDENTICAL to the single-pool engine and
+      reports both twins' effective tokens/s and decode TBT p50 (the ring
+      tax on contexts that never needed the seq axis);
+    * **over-one-pool** — a prompt bigger than ONE slice's block budget:
+      the single-SLICE twin (same per-chip pool, no seq axis) must reject
+      it with the typed ``pool_impossible`` verdict carrying the budget it
+      was judged against, and the seq-sharded engine must admit it, serve
+      it to terminal, and drain zero-leak.
+
+    Prints one JSON line with both phases' numbers and returns the
+    payload (the tier-1 in-proc smoke gate calls this directly)."""
+    import os
+
+    # virtual CPU devices must exist before the backend initializes; the
+    # flag only affects the CPU client (same rule as audit_main)
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.inference.scheduler import REJECT_POOL_IMPOSSIBLE
+    from deepspeed_tpu.models import get_preset
+    from deepspeed_tpu.models.transformer import init_params
+    from deepspeed_tpu.parallel.topology import initialize_mesh
+    from deepspeed_tpu.telemetry import (format_percentile_table,
+                                         percentile_summary)
+
+    seq_shards = 2
+    if len(jax.devices()) < seq_shards:
+        raise SystemExit(
+            f"--longctx needs {seq_shards} devices, have "
+            f"{len(jax.devices())}")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and not smoke:
+        cfg = get_preset("llama3_proxy_410m")
+        dtype = jnp.bfloat16
+        n_req, sys_len, sfx_len, max_new = 8, 256, 64, 32
+        # aggregate 96 blocks x 32 = 3072 tokens; one slice holds 1536
+        blocks, block_size = 96, 32
+        ekw = dict(max_seqs=4, block_size=block_size, max_seq_len=2048,
+                   prefill_buckets=(64, 128, 256, 512, 1024, 2048),
+                   prefill_budget=2048, prefill_chunk=256)
+        long_len = 1792  # 56 blocks: over one slice, under the aggregate
+        check_identity = False  # bf16 near-ties may flip greedy argmax
+    else:  # CPU smoke (the CI fast lane): fp32 so identity is exact
+        cfg = get_preset("tiny", max_seq_len=512, dtype=jnp.float32)
+        dtype = jnp.float32
+        n_req, sys_len, sfx_len, max_new = 6, 24, 8, 8
+        # aggregate 16 blocks x 8 = 128 tokens; one slice holds 64
+        blocks, block_size = 16, 8
+        ekw = dict(max_seqs=2, block_size=block_size, max_seq_len=120,
+                   prefill_buckets=(32, 64, 128))
+        long_len = 80  # 10 blocks: over one slice's 8, under the 16
+        check_identity = True
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=dtype)
+    samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
+
+    def make_engine(shards: int, num_blocks: int):
+        grid = None
+        kw = dict(ekw)
+        if shards > 1:
+            grid = initialize_mesh(devices=jax.devices()[:shards],
+                                   seq=shards, model=1)
+            kw.update(seq_shards=shards)
+        return InferenceEngineV2(params, cfg, grid=grid, telemetry=True,
+                                 enable_prefix_caching=True,
+                                 num_blocks=num_blocks,
+                                 quantize_weights=quant, **kw)
+
+    def run_once(shards: int):
+        """One full arrival run on a fresh engine (fresh numpy rng) at the
+        same AGGREGATE pool budget — only the mesh layout differs."""
+        rng = np.random.default_rng(0)
+        sys_prompt = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+        prompts = {
+            u: sys_prompt + rng.integers(1, cfg.vocab_size, sfx_len).tolist()
+            for u in range(1, n_req + 1)
+        }
+        arrival_steps = rng.poisson(2.0, n_req)
+        eng = make_engine(shards, blocks)
+        sched = eng.scheduler
+        arrivals = np.cumsum(arrival_steps)
+        submitted = 0
+        t0 = time.perf_counter()
+        while submitted < n_req or not sched.idle:
+            while submitted < n_req and arrivals[submitted] <= sched.tick_no:
+                submitted += 1
+                sched.submit(submitted, prompts[submitted], samp)
+            sched.tick()
+        dt = time.perf_counter() - t0
+        results = {u: sched.pop_result(u) for u in range(1, n_req + 1)}
+        assert all(len(r) == max_new for r in results.values()), \
+            "requests failed"
+        eng.telemetry.flush()
+        pct = percentile_summary(eng.telemetry.registry,
+                                 ("serve/tbt_ms", "serve/decode_tick_ms"))
+        total = (sum(len(p) for p in prompts.values())
+                 + sum(len(r) for r in results.values()))
+        audit = eng.close()
+        assert audit["blocks_in_use"] == 0, audit
+        return dict(results=results, tok_s=total / dt, pct=pct,
+                    tbt_p50=pct.get("tbt_ms", {}).get("p50"))
+
+    # --- phase 1: fits-either workload, equal aggregate budget ----------
+    sharded = run_once(seq_shards)
+    single = run_once(1)
+    token_identical = sharded["results"] == single["results"]
+    if check_identity:
+        assert token_identical, (
+            "seq-sharded decode diverged from single-pool greedy decode")
+
+    # --- phase 2: a prompt bigger than one slice's block budget ---------
+    rng = np.random.default_rng(1)
+    long_prompt = rng.integers(1, cfg.vocab_size, long_len).tolist()
+    slice_blocks = blocks // seq_shards
+    # the single-SLICE twin: same per-chip pool, no seq axis to borrow from
+    small = make_engine(1, slice_blocks)
+    verdict = small.scheduler.try_submit(1, long_prompt, samp)
+    assert not verdict.accepted \
+        and verdict.reason == REJECT_POOL_IMPOSSIBLE, verdict
+    assert verdict.budget_blocks == slice_blocks, verdict
+    small.close()
+    eng = make_engine(seq_shards, blocks)
+    sched = eng.scheduler
+    res = sched.try_submit(1, long_prompt, samp)
+    assert res.accepted, res
+    sched.run(wait_for=[1])
+    assert sched.requests[1].state == "finished", (
+        sched.requests[1].state, sched.requests[1].error)
+    long_out = sched.pop_result(1)
+    assert len(long_out) == max_new, long_out
+    audit = eng.close()
+    assert audit["blocks_in_use"] == 0, audit
+
+    print(format_percentile_table(
+        sharded["pct"], title=f"serve latency (seq_shards={seq_shards})"))
+    payload = {
+        "metric": "serve_longctx_seq_sharded_effective_tokens_per_sec",
+        "value": round(sharded["tok_s"], 1),
+        "unit": "tokens/s",
+        "extra": {
+            "seq_shards": seq_shards, "requests": n_req,
+            "shared_prefix": sys_len, "max_new_tokens": max_new,
+            "quantize_weights": quant,
+            "single_pool_tokens_per_sec": round(single["tok_s"], 1),
+            "tbt_p50_ms_single_pool": single["tbt_p50"],
+            "tbt_p50_ms_seq_sharded": sharded["tbt_p50"],
+            "greedy_token_identical": token_identical,
+            "longctx": {
+                "prompt_tokens": long_len,
+                "slice_budget_tokens": slice_blocks * block_size,
+                "aggregate_budget_tokens": blocks * block_size,
+                "single_slice_reject": {
+                    "reason": verdict.reason,
+                    "budget_blocks": verdict.budget_blocks,
+                    "budget_scope": verdict.budget_scope,
+                },
+                "seq_sharded_served_tokens": len(long_out),
+                "zero_leak": True,
+            },
+        },
+    }
+    print(json.dumps(payload))
+    return payload
+
+
 def adapt_serve_main(smoke: bool = False, quant=None):
     """Online-adaptation drift twin (`python bench.py --serving --adapt
     [--smoke] [--quant int8]`): the SAME three-phase drift workload —
@@ -2792,6 +2967,8 @@ if __name__ == "__main__":
             autotune_serving_main(smoke=smoke, out=out)
     elif "--serving" in sys.argv and "--adapt" in sys.argv:
         adapt_serve_main(smoke=smoke, quant=q)
+    elif "--serving" in sys.argv and "--longctx" in sys.argv:
+        longctx_serve_main(smoke=smoke, quant=q)
     elif "--serving" in sys.argv and "--router" in sys.argv:
         router_serve_main(smoke=smoke, chaos="--chaos" in sys.argv)
     elif "--serving" in sys.argv and "--chaos" in sys.argv:

@@ -97,6 +97,13 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "inference/model_runner.py": {"*"},
     "inference/sampling.py": {"*"},
     "inference/paged.py": {"*"},
+    # seq-striped allocation bookkeeping (ISSUE 18): these run under the
+    # scheduler's intake lock on every admit/grow/evict — pure host list
+    # arithmetic; a device sync or raw collective here would stall every
+    # submitter behind the lock
+    "inference/ragged.py": {"allocate", "can_allocate", "_evict_one",
+                            "_push_free", "stripe_of", "free", "invalidate",
+                            "ensure_capacity", "ensure_writable"},
 }
 
 # grandfathered `global` rebinds: (file, name).  Shrink-only.

@@ -126,6 +126,9 @@ def serve_jit_specs(eng, sampling=None) -> Dict[str, dict]:
               key, tr),
         donated={"kv": 6}, static=(8,),
         n_tokens=t_pad, sample_rows=B,
+        # cold pack: dense attention only, never reads the paged pool — no
+        # seq-shard ring in this dispatch
+        ring=False,
     )
 
     ctx_tables = jnp.full((B, eng.max_pages), -1, jnp.int32)
@@ -177,6 +180,7 @@ def audit_serve_engine(
     report: Dict[str, object] = {
         "engine": {
             "tp": tp, "serve_replicas": eng.serve_replicas,
+            "seq_shards": getattr(eng, "seq_shards", 1),
             "quant_comm": fmt, "comm_tiles": eng.serving_ctx.comm_tiles,
             "quantize_weights": eng.quantize_weights,
             "max_seqs": eng.mgr.max_seqs, "num_layers": eng.cfg.num_layers,
@@ -198,6 +202,9 @@ def audit_serve_engine(
             eng.cfg, spec["n_tokens"], tp, fmt,
             tiles=max(eng.serving_ctx.comm_tiles, 1),
             sample_rows=spec["sample_rows"],
+            seq_shards=(getattr(eng, "seq_shards", 1)
+                        if spec.get("ring", True) else 1),
+            replicas=eng.serve_replicas,
         )
         required = donation_param_numbers(
             compiled, spec["args"], spec["donated"], spec.get("static", ()))

@@ -48,6 +48,7 @@ class RooflineConstants:
     ici_gbps: float = 40.0            # interconnect GB/s per device
     hbm_bytes: float = 16e9           # HBM capacity
     host_tick_s: float = 200e-6       # per-dispatch host overhead
+    ici_hop_s: float = 1e-6           # per-collective-permute hop latency
     sources: Tuple[str, ...] = ()     # artifact files that informed a rate
 
     @classmethod
@@ -160,11 +161,12 @@ def serving_feasible(cand: Dict[str, Any], model_cfg, base: Dict[str, Any],
     Returns ``(ok, reason)`` — reasons become leaderboard verdicts."""
     tp = int(cand.get("tp", 1))
     dp = int(cand.get("serve_replicas", 1))
-    if tp < 1 or dp < 1:
-        return False, "structural: tp/serve_replicas must be >= 1"
-    if tp * dp > n_devices:
-        return False, (f"structural: tp*replicas {tp * dp} exceeds "
-                       f"{n_devices} devices")
+    sq = int(cand.get("seq_shards", 1) or 1)
+    if tp < 1 or dp < 1 or sq < 1:
+        return False, "structural: tp/serve_replicas/seq_shards must be >= 1"
+    if tp * dp * sq > n_devices:
+        return False, (f"structural: tp*replicas*seq_shards {tp * dp * sq} "
+                       f"exceeds {n_devices} devices")
     if model_cfg.num_heads % tp:
         return False, (f"structural: num_heads {model_cfg.num_heads} "
                        f"not divisible by tp {tp}")
@@ -176,6 +178,11 @@ def serving_feasible(cand: Dict[str, Any], model_cfg, base: Dict[str, Any],
         # feasible and searchable; only the structural pool split remains
         if base.get("max_seqs", 0) % dp or base.get("num_blocks", 0) % dp:
             return False, "structural: max_seqs/num_blocks must divide replicas"
+    if sq > 1 and base.get("num_blocks", 0) % (dp * sq):
+        # the engine's own bring-up gate: each replica's pool must split
+        # into sq equal contiguous stripes (seq-axis device slices)
+        return False, ("structural: num_blocks must divide "
+                       "replicas x seq_shards")
     if cand.get("quant_comm", "none") != "none" and tp <= 1:
         return False, "structural: quant_comm needs a TP mesh"
     megastep = cand.get("decode_megastep", 1)
@@ -184,7 +191,7 @@ def serving_feasible(cand: Dict[str, Any], model_cfg, base: Dict[str, Any],
     consts = consts or RooflineConstants()
     need = (weight_stream_bytes(model_cfg, cand.get("quant")) / tp
             + kv_pool_bytes(model_cfg, base.get("num_blocks", 0),
-                            base.get("block_size", 32)) / max(dp, 1)
+                            base.get("block_size", 32)) / max(dp * sq, 1)
             + 0.05 * consts.hbm_bytes)  # activation/jit slack
     if need > consts.hbm_bytes:
         return False, (f"memory: est {need / 1e9:.2f} GB per device > "
@@ -207,15 +214,28 @@ def predict_serve_cost(cand: Dict[str, Any], model_cfg,
     consts = consts or RooflineConstants()
     tp = max(int(cand.get("tp", 1)), 1)
     dp = max(int(cand.get("serve_replicas", 1)), 1)
+    sq = max(int(cand.get("seq_shards", 1) or 1), 1)
     B = max(int(base.get("max_seqs", 1)), 1)
     t = weight_stream_bytes(model_cfg, cand.get("quant")) / tp \
         / (consts.hbm_gbps * 1e9)
-    if tp > 1:
+    # KV-read roofline (the term seq sharding actually moves): a decode
+    # tick streams the live context KV, bounded by one device's pool slice
+    # — splitting the pool over dp x sq slices multiplies the effective
+    # KV-streaming bandwidth per token by the slice count.  Callers that
+    # pass no ``num_blocks`` in ``base`` (format-ordering comparisons)
+    # charge nothing here, as before.
+    t += kv_pool_bytes(model_cfg, base.get("num_blocks", 0),
+                       base.get("block_size", 32)) / (dp * sq) \
+        / (consts.hbm_gbps * 1e9)
+    if tp > 1 or sq > 1:
         plan = serving_tick_plan(
             model_cfg, B, tp, cand.get("quant_comm", "none"),
-            sample_rows=B, compute_itemsize=2,
+            sample_rows=B, compute_itemsize=2, seq_shards=sq, replicas=dp,
         )
         t += plan_bytes(plan) / (consts.ici_gbps * 1e9)
+        # the ring's cost at decode widths is hop LATENCY, not bytes: S-1
+        # nearest-neighbour permutes per layer sit on the critical path
+        t += (sq - 1) * model_cfg.num_layers * consts.ici_hop_s
     # megastep fuses n decode ticks into ONE device burst (one host sync),
     # amortizing the host dispatch across the fused ticks; the device time
     # per tick is unchanged.  _canon_serving pins megastep to 1 under spec

@@ -111,7 +111,8 @@ def training_space(
     )
 
 
-def _canon_serving(cand: Dict[str, Any]) -> Dict[str, Any]:
+def _canon_serving(cand: Dict[str, Any],
+                   longctx: bool = False) -> Dict[str, Any]:
     c = dict(cand)
     if not c.get("spec", False):
         c["spec_max_draft"] = 0  # drafter off: the knob is inert
@@ -128,6 +129,13 @@ def _canon_serving(cand: Dict[str, Any]) -> Dict[str, Any]:
         # the scheduler collapses a megastep to per-tick whenever live
         # speculation proposals exist, so the knob is inert under spec
         c["decode_megastep"] = 1
+    # pre-seq-shard candidate dicts canonicalize onto the single-pool row
+    c.setdefault("seq_shards", 1)
+    if not longctx:
+        # every prompt fits one replica's pool slice: striping pages over a
+        # seq axis buys nothing a wider pool doesn't, so the seq_shards > 1
+        # rows collapse onto their S=1 twin instead of being measured twice
+        c["seq_shards"] = 1
     return c
 
 
@@ -143,6 +151,8 @@ def serving_space(
     comm_tiles: Sequence[int] = (1,),
     prefix_caching: Sequence[bool] = (True,),
     decode_megastep: Sequence[int] = (1, 4),
+    seq_shards: Sequence[int] = (1, 2),
+    longctx: bool = False,
 ) -> SearchSpace:
     """Serving search space over the engine/scheduler knobs accumulated
     since PR 2.  Values mirror the ``InferenceEngineV2`` constructor
@@ -153,7 +163,14 @@ def serving_space(
     feature gates — ``roofline.serving_feasible`` only checks the
     structural pool split (``max_seqs``/``num_blocks`` divisibility)
     there, so R>1 candidates with caching/chunking/speculation on survive
-    the static prune and get measured."""
+    the static prune and get measured.
+
+    ``longctx`` is the caller's declaration that the workload's longest
+    context does NOT fit one replica's pool slice; without it every
+    ``seq_shards`` > 1 row canonicalizes onto its S=1 twin (seq sharding
+    is a long-context capability knob — on a fits-one-pool workload it
+    only adds ring hops) so the grid never measures the same effective
+    config twice."""
     return SearchSpace(
         knobs=[
             Knob("tp", tuple(tp)),
@@ -167,6 +184,7 @@ def serving_space(
             Knob("quant_comm", tuple(quant_comm)),
             Knob("comm_tiles", tuple(comm_tiles)),
             Knob("decode_megastep", tuple(decode_megastep)),
+            Knob("seq_shards", tuple(seq_shards)),
         ],
-        canonicalize=_canon_serving,
+        canonicalize=lambda c: _canon_serving(c, longctx=longctx),
     )

@@ -76,10 +76,12 @@ def serving_tick_plan(
     tiles: int = 1,
     sample_rows: int = 0,
     compute_itemsize: Optional[int] = None,
+    seq_shards: int = 1,
+    replicas: int = 1,
 ) -> List[PlannedCollective]:
     """Collectives of ONE serving dispatch (decode tick / packed prefill /
     verify) running ``n_tokens`` activation rows on a ``tp``-way model
-    axis.  Empty without TP.
+    axis.  Empty without TP and without seq sharding.
 
     - 2 row-parallel transports per layer (o + down), ``n_tokens x hidden``
       at the engine's ``fmt`` (the exact set ``_account_comm`` counts and
@@ -95,9 +97,15 @@ def serving_tick_plan(
     - 2 activation all-gathers per layer, ``n_tokens x hidden`` (GSPMD
       keeps the residual stream hidden-sharded between row psums; each
       column-parallel block input re-gathers), plus the pre-head gather
-      of the ``sample_rows`` rows actually scored.
+      of the ``sample_rows`` rows actually scored;
+    - with ``seq_shards`` (S) > 1, the paged-attention log-sum-exp ring:
+      ``S-1`` nearest-neighbour ``collective_permute`` hops per layer, each
+      carrying the fp32 ``[rows, heads, head_dim+2]`` flash accumulator at
+      its LOCAL shard shape (``rows/replicas`` batch rows, ``heads/tp``
+      query heads) — the one transport the seq axis costs, issued from
+      ``qcomm.ring_permute`` inside the decode/packed-ctx shard_map.
     """
-    if tp <= 1:
+    if tp <= 1 and seq_shards <= 1:
         return []
     import jax.numpy as jnp
 
@@ -106,6 +114,20 @@ def serving_tick_plan(
     d = cfg.hidden_size
     n_proj = 2 * cfg.num_layers  # o + down per layer, both [n_tokens, d]
     plan: List[PlannedCollective] = []
+    if seq_shards > 1:
+        hq_local = (cfg.num_heads // tp if tp > 1 and cfg.num_heads % tp == 0
+                    else cfg.num_heads)
+        rows = -(-n_tokens // max(replicas, 1))
+        plan.append(PlannedCollective(
+            op="collective_permute",
+            n_elements=rows * hq_local * (cfg.hd + 2),
+            fmt="none", world=seq_shards,
+            count=(seq_shards - 1) * cfg.num_layers,
+            none_bytes_per_el=4,  # fp32 accumulator, regardless of cfg dtype
+            label="seq_ring",
+        ))
+    if tp <= 1:
+        return plan
     tiles_eff = tiles if (tiles > 1 and d >= tiles) else 1
     if tiles_eff == 1 and fmt == "none":
         plan.append(PlannedCollective(

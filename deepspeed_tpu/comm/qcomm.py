@@ -147,12 +147,41 @@ def wire_bytes(op: str, n_elements: int, fmt: str, world: int,
         return 2 * (body * (world - 1) // world)
     if op == "all_to_all":
         return body * (world - 1) // world
+    if op == "collective_permute":
+        # point-to-point: every device sends the FULL buffer once per call
+        # (no (W-1)/W ring discount — there is no ring decomposition to
+        # amortize; ``world`` is accepted for signature symmetry only)
+        return body
     raise QCommError(f"wire_bytes op {op!r}")
 
 
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
+def ring_permute(x: jnp.ndarray, axis_name: AxisNames,
+                 world: Optional[int] = None) -> jnp.ndarray:
+    """One nearest-neighbour ring hop: rank ``i`` sends ``x`` to rank
+    ``(i + 1) % world`` and receives rank ``(i - 1) % world``'s buffer.
+
+    The point-to-point primitive of the seq-sharded decode ring
+    (``inference/paged.py``): the ``[B, hq, hd+2]`` flash accumulator
+    travels exactly ``world - 1`` hops, each fully counted by
+    ``wire_bytes('collective_permute', ...)`` — no (W-1)/W ring discount,
+    a permute ships its whole payload.  Exact (no quantized variant: the
+    accumulator is an fp32 running max/denominator/weighted sum, and
+    requantizing partials per hop would compound error ``world`` times).
+
+    Must run inside a ``shard_map`` region over ``axis_name``.  ``world``
+    defaults to the live axis size.
+    """
+    if world is None:
+        from ..parallel.sharding import collective_axis_size
+
+        world = collective_axis_size(axis_name)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
 def q_all_gather(
     x: jnp.ndarray,
     axis_name: AxisNames,

@@ -358,8 +358,8 @@ class ServeEngineConfig:
     autotuner's trials, the bench's winner-verification re-run, and any
     front end all construct engines through ONE path
     (``inference.engine_v2.build_serve_engine``) instead of re-spelling
-    keyword soup.  ``tp``/``serve_replicas`` > 1 make the builder bring up
-    the batch x model mesh itself."""
+    keyword soup.  ``tp``/``serve_replicas``/``seq_shards`` > 1 make the
+    builder bring up the batch x seq x model mesh itself."""
 
     max_seqs: int = 8
     num_blocks: int = 96
@@ -376,13 +376,14 @@ class ServeEngineConfig:
     quantize_weights: Optional[str] = None
     tp: int = 1
     serve_replicas: int = 1
+    seq_shards: int = 1
     quant_comm: str = "none"
     comm_tiles: int = 1
     seed: int = 0
 
     def __post_init__(self):
         for k in ("max_seqs", "num_blocks", "block_size", "tp",
-                  "serve_replicas", "comm_tiles"):
+                  "serve_replicas", "seq_shards", "comm_tiles"):
             if int(getattr(self, k)) < 1:
                 raise ConfigError(f"serve_engine.{k} must be >= 1, got "
                                   f"{getattr(self, k)}")
@@ -421,6 +422,7 @@ class ServeEngineConfig:
             spec_max_draft=max(self.spec_max_draft, 1),
             quantize_weights=self.quantize_weights,
             serve_replicas=self.serve_replicas,
+            seq_shards=self.seq_shards,
             quant_comm=self.quant_comm, comm_tiles=self.comm_tiles,
             seed=self.seed,
         )

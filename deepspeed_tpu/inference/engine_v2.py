@@ -68,6 +68,7 @@ class InferenceEngineV2:
         faults=None,
         fused_serving: Optional[bool] = None,
         serve_replicas: int = 1,
+        seq_shards: int = 1,
         quant_comm: Optional[str] = None,
         comm_tiles: Optional[int] = None,
     ):
@@ -98,6 +99,7 @@ class InferenceEngineV2:
         # replicated behavior there.
         tp = grid.spec.model if grid is not None else 1
         dp = int(serve_replicas)
+        sq = int(seq_shards)
         if dp > 1:
             if grid is None or grid.spec.data != dp:
                 raise ValueError(
@@ -110,6 +112,26 @@ class InferenceEngineV2:
                     f"max_seqs ({max_seqs}) and num_blocks ({num_blocks}) "
                     f"must divide into {dp} serve replicas"
                 )
+        # 3-D batch x seq x model mesh: ``seq_shards`` > 1 additionally
+        # slices each replica's block pool over the mesh's seq axis.  A
+        # sequence's pages round-robin across the slices (StateManager
+        # striping), each seq shard computes a flash-style PARTIAL over its
+        # local pages, and a log-sum-exp ring pass (S-1 collective_permute
+        # hops of the [B, hq, hd+2] accumulator) merges the partials — so a
+        # context bigger than one slice's pool serves fine as long as the
+        # AGGREGATE pool fits it.
+        if sq > 1:
+            if grid is None or grid.spec.seq != sq:
+                raise ValueError(
+                    f"seq_shards={sq} needs a grid whose seq axis is "
+                    f"exactly {sq} — build it with "
+                    f"initialize_mesh(seq={sq}, model=..., batch=...)"
+                )
+            if num_blocks % (dp * sq):
+                raise ValueError(
+                    f"num_blocks ({num_blocks}) must divide into "
+                    f"{dp} replicas x {sq} seq shards"
+                )
             # Prefix caching, chunked prefill and speculation are
             # REPLICA-AFFINE at dp > 1 (nothing is gated any more):
             # admission routes a prompt to the replica holding its deepest
@@ -121,6 +143,7 @@ class InferenceEngineV2:
             # paged_attention_decode performs — no pack ever reads the
             # pool across the batch axis.
         self.serve_replicas = dp
+        self.seq_shards = sq
         # Quantized-weight serving (reference csrc/fp_quantizer + FP6 blog
         # 1.69-2.65x claim): big matmul kernels stored int8/fp8 with per-
         # output-channel scales; serving_mm applies the scale post-matmul so
@@ -160,7 +183,7 @@ class InferenceEngineV2:
         # model that trains under zero.Init serves the same way: sharded.
         self.grid = grid
         self._mesh = None
-        if grid is not None and (tp > 1 or dp > 1):
+        if grid is not None and (tp > 1 or dp > 1 or sq > 1):
             if offload_weights:
                 raise ValueError(
                     "offload_weights and tensor-parallel serving are "
@@ -271,7 +294,7 @@ class InferenceEngineV2:
         self.faults = faults
         self.mgr = StateManager(num_blocks, block_size, max_seqs,
                                 enable_prefix_caching=enable_prefix_caching,
-                                replicas=dp)
+                                replicas=dp, seq_shards=sq)
         self.mgr.faults = faults
         # per-replica speculation totals [drafted, accepted] — the
         # spec-accept half of the serve/replicaN/* gauge group (drafts and
@@ -374,7 +397,7 @@ class InferenceEngineV2:
             from jax.sharding import NamedSharding
 
             kv_sh = NamedSharding(
-                self._mesh, kv_pool_pspec(cfg.num_kv_heads, tp, dp)
+                self._mesh, kv_pool_pspec(cfg.num_kv_heads, tp, dp, sq)
             )
             self._kv_shardings = (kv_sh, kv_sh)
             self.kv = jax.device_put(self.kv, self._kv_shardings)
@@ -404,6 +427,7 @@ class InferenceEngineV2:
         # shard_map'd quant-matmul regions inside the compiled dispatches
         ctx_ = self.serving_ctx
         dp_ = self.serve_replicas
+        sq_ = self.seq_shards
         mesh_ = self._mesh
 
         # only the device-relevant sampling triple is static — hashing the
@@ -430,6 +454,7 @@ class InferenceEngineV2:
             logits, kv = model_runner.prefill_packed_ctx(
                 params, cfg_, tokens, seg, pos, pack_pages, last_idx,
                 ctx_tables, ctx_lens, kv, ctx=ctx_, mesh=mesh_, dp=dp_,
+                seq_shards=sq_,
             )
             t, k, p = sampling_triple
             sampled = sample(logits, SamplingParams(t, k, p), rng)
@@ -452,7 +477,7 @@ class InferenceEngineV2:
             dispatch call itself (the tunnel-RTT killer, r4 VERDICT weak #1)."""
             logits, kv = model_runner.decode_step(
                 params, cfg_, tokens, seq_lens, block_tables, active, kv,
-                ctx=ctx_, mesh=mesh_, dp=dp_,
+                ctx=ctx_, mesh=mesh_, dp=dp_, seq_shards=sq_,
             )
             t, k, p = sampling_triple
             rng, sub = jax.random.split(rng)
@@ -488,7 +513,7 @@ class InferenceEngineV2:
             from ~14 ms to 20-70 ms on the tunnel-attached chip."""
             logits, kv = model_runner.decode_step(
                 params, cfg_, tokens, seq_lens, block_tables, active, kv,
-                ctx=ctx_, mesh=mesh_, dp=dp_,
+                ctx=ctx_, mesh=mesh_, dp=dp_, seq_shards=sq_,
             )
             t, k, p = sampling_triple
             rng, sub = jax.random.split(rng)
@@ -532,6 +557,7 @@ class InferenceEngineV2:
             logits, kv = model_runner.verify_packed_ctx(
                 params, cfg_, tokens, seg, pos, dst_pages, dst_offs,
                 ctx_tables, ctx_lens, kv, ctx=ctx_, mesh=mesh_, dp=dp_,
+                seq_shards=sq_,
             )
             k1 = draft.shape[1] + 1
             logits = logits.reshape(draft.shape[0], k1, -1)
@@ -953,7 +979,7 @@ class InferenceEngineV2:
         sp.dispatched()
         self._c["prefill_tokens_dispatched"].inc(n_real)
         self._c["prefill_dispatches"].inc()
-        self._account_comm(t_pad, sample_rows=n_slots)
+        self._account_comm(t_pad, sample_rows=n_slots, ring=use_ctx)
         poison = self._poisoned(
             [s.uid for s, _, end in entries if end == len(s.tokens)]
         )
@@ -1042,7 +1068,8 @@ class InferenceEngineV2:
         return jax.device_put(x, self._rep_sharding)
 
     def _account_comm(self, n_tokens: int, reps: int = 1,
-                      sample_rows: Optional[int] = None) -> None:
+                      sample_rows: Optional[int] = None,
+                      ring: bool = True) -> None:
         """Wire-byte accounting for ONE dispatch's TP collectives into the
         ``comm/*`` counters, from the shared :mod:`comm.budget` plan (the
         same enumeration the Graft Auditor checks against the compiled
@@ -1054,9 +1081,13 @@ class InferenceEngineV2:
         head-input gathers).  ``reps``: identical dispatches to account at
         once (a step_n burst is ``n`` decode ticks); ``sample_rows``:
         rows the dispatch scores logits for (defaults to ``n_tokens`` —
-        packed prefill passes its slot count).  No-op without a TP mesh."""
+        packed prefill passes its slot count).  ``ring``: whether the
+        dispatch reads the paged pool — the seq-shard log-sum-exp ring only
+        runs in pool-reading dispatches (decode/ctx/verify; a COLD prefill
+        pack attends densely and hops nothing).  No-op without a TP mesh
+        and without seq shards."""
         ctx = self.serving_ctx
-        if self._mesh is None or ctx.size <= 1:
+        if self._mesh is None or (ctx.size <= 1 and self.seq_shards <= 1):
             return
         from ..comm import budget
 
@@ -1064,6 +1095,8 @@ class InferenceEngineV2:
             self.cfg, n_tokens, ctx.size, ctx.comm_fmt,
             tiles=max(ctx.comm_tiles, 1),
             sample_rows=n_tokens if sample_rows is None else sample_rows,
+            seq_shards=self.seq_shards if ring else 1,
+            replicas=self.serve_replicas,
         )
         self._comm_c["bytes_on_wire"].inc(
             reps * budget.plan_bytes(plan, overhead=False))
@@ -1917,31 +1950,34 @@ def build_serve_engine(params, cfg, sec, *, telemetry=None, serve=None,
                        faults=None, devices=None) -> InferenceEngineV2:
     """The canonical config -> engine seam: build an ``InferenceEngineV2``
     from a validated ``config.ServeEngineConfig`` (or a dict coerced into
-    one).  ``tp``/``serve_replicas`` > 1 bring up the batch x model mesh
-    here, so every caller — autotuner trials, the bench's winner
-    verification, front ends — constructs multi-chip engines through one
-    path instead of re-deriving mesh arithmetic.
+    one).  ``tp``/``serve_replicas``/``seq_shards`` > 1 bring up the
+    batch x seq x model mesh here, so every caller — autotuner trials, the
+    bench's winner verification, front ends — constructs multi-chip
+    engines through one path instead of re-deriving mesh arithmetic.
 
     ``devices`` restricts the mesh to a device subset (defaults to the
-    first ``tp * serve_replicas`` of ``jax.devices()``)."""
+    first ``tp * serve_replicas * seq_shards`` of ``jax.devices()``)."""
     from ..config.config import ServeEngineConfig, _coerce
 
     sec = sec if isinstance(sec, ServeEngineConfig) \
         else _coerce(ServeEngineConfig, dict(sec))
     grid = None
-    if sec.tp > 1 or sec.serve_replicas > 1:
+    if sec.tp > 1 or sec.serve_replicas > 1 or sec.seq_shards > 1:
         from ..parallel.topology import initialize_mesh
 
         devs = list(devices if devices is not None else jax.devices())
-        need = sec.tp * sec.serve_replicas
+        need = sec.tp * sec.serve_replicas * sec.seq_shards
         if len(devs) < need:
             raise ValueError(
                 f"serve_engine tp={sec.tp} x serve_replicas="
-                f"{sec.serve_replicas} needs {need} devices, have {len(devs)}"
+                f"{sec.serve_replicas} x seq_shards={sec.seq_shards} "
+                f"needs {need} devices, have {len(devs)}"
             )
         axes = {"model": sec.tp}
         if sec.serve_replicas > 1:
             axes["batch"] = sec.serve_replicas
+        if sec.seq_shards > 1:
+            axes["seq"] = sec.seq_shards
         grid = initialize_mesh(devices=devs[:need], **axes)
     return InferenceEngineV2(
         params, cfg, grid=grid, telemetry=telemetry, serve=serve,

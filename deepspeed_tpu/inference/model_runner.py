@@ -254,6 +254,7 @@ def prefill_packed_ctx(
     ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
     mesh=None,  # TP/2-D serving: shard_map the ctx attention (see paged.py)
     dp: int = 1,  # batch-axis replicas — packs arrive as dp per-replica chunks
+    seq_shards: int = 1,  # seq-axis pool slices (3-D mesh, ring-merged)
 ):
     """``prefill_packed`` generalized to token SUFFIXES: each packed segment
     starts at a per-sequence offset (``ctx_lens``) and attends over its
@@ -302,7 +303,7 @@ def prefill_packed_ctx(
         attn = paged_attention_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
-            mesh=mesh, dp=dp,
+            mesh=mesh, dp=dp, seq_shards=seq_shards,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
@@ -329,6 +330,7 @@ def verify_packed_ctx(
     ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
     mesh=None,  # TP/2-D serving: shard_map the ctx attention (see paged.py)
     dp: int = 1,  # batch-axis replicas (slot-ordered rows chunk naturally)
+    seq_shards: int = 1,  # seq-axis pool slices (3-D mesh, ring-merged)
 ):
     """Speculative-decode verify: score k+1 positions per sequence in ONE
     pass — the dispatch that amortizes the weight stream across several
@@ -379,7 +381,7 @@ def verify_packed_ctx(
         attn = paged_attention_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
-            mesh=mesh, dp=dp,
+            mesh=mesh, dp=dp, seq_shards=seq_shards,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
@@ -402,6 +404,7 @@ def decode_step(
     ctx=None,  # ops.quantizer.ServingContext — TP/fused serving policy
     mesh=None,  # TP serving: shard_map the paged attention over 'model'
     dp: int = 1,  # batch-axis replicas (2-D batch x model serve mesh)
+    seq_shards: int = 1,  # seq-axis pool slices (3-D mesh, ring-merged)
 ):
     """One batched decode tick: returns (logits [B, v], new caches)."""
     b = tokens.shape[0]
@@ -431,6 +434,7 @@ def decode_step(
         attn = paged_attention_decode(
             q[:, 0], new_ck[l], new_cv[l], block_tables, seq_lens + 1,
             logits_soft_cap=cfg.logits_soft_cap, mesh=mesh, dp=dp,
+            seq_shards=seq_shards,
         )
         attn = _attn_out(lw["attn"], attn.reshape(b, 1, -1), ctx)
         x = x + attn.astype(x.dtype)

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 
 from ..ops.attention import repeat_kv
 
+_NEG_INF = -1e30  # finite mask value: exp(_NEG_INF - _NEG_INF) stays finite
+
 
 def init_paged_cache(
     num_layers: int, num_blocks: int, block_size: int, num_kv_heads: int,
@@ -95,6 +97,7 @@ def write_spec_kv(cache_layer, kv, pages, offsets):
 def paged_attention_packed_ctx(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
     scale=None, logits_soft_cap=None, mesh=None, dp: int = 1,
+    seq_shards: int = 1,
 ):
     """Packed-prefill attention where each pack segment ALSO attends to its
     sequence's cached KV pages (positions below its start offset) — the
@@ -125,11 +128,18 @@ def paged_attention_packed_ctx(
     against its LOCAL pool slice with the same global→local block-id
     translation decode already performs.  Nothing reads the pool across
     the batch axis.
+
+    ``seq_shards > 1``: cached pages stripe across the ``seq`` shards, so
+    each shard computes a flash partial over its locally-owned ctx pages —
+    the pack's fresh (causal, in-flight) keys are charged to seq shard 0
+    only so the log-sum-exp ring merge counts them exactly once — and the
+    ``S`` partials combine with the same ``S-1``-hop ring pass as decode.
     """
-    if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1):
+    if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1
+                             or seq_shards > 1):
         return _paged_attention_packed_ctx_tp(
             q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
-            ctx_lens, mesh, dp=dp, scale=scale,
+            ctx_lens, mesh, dp=dp, seq_shards=seq_shards, scale=scale,
             logits_soft_cap=logits_soft_cap,
         )
     return _paged_attention_packed_ctx_dense(
@@ -140,9 +150,10 @@ def paged_attention_packed_ctx(
 
 def _paged_attention_packed_ctx_tp(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
-    mesh, dp=1, scale=None, logits_soft_cap=None,
+    mesh, dp=1, seq_shards=1, scale=None, logits_soft_cap=None,
 ):
-    """Manual-region packed-ctx attention on the (batch, model) serve mesh.
+    """Manual-region packed-ctx attention on the (batch, seq, model) serve
+    mesh.
 
     Replica-locality contract (the engine's pack builder guarantees it):
     chunk ``r`` of the pack ([r*T/dp, (r+1)*T/dp)) holds only segments of
@@ -152,15 +163,22 @@ def _paged_attention_packed_ctx_tp(
     translate by the constant slice offset, slot rows by the slot-group
     offset — with no collective in the region at all (out rows shard the
     same way the chunk does).
+
+    ``seq_shards > 1`` breaks that no-collective property on purpose: ctx
+    pages stripe across the seq shards, so each shard flash-accumulates its
+    locally-owned ctx keys (pack keys charged to seq shard 0 only) and the
+    partials ring-merge over ``seq`` exactly like the decode region.
     """
     import functools
 
     from jax.sharding import PartitionSpec as P
 
+    from ..comm import qcomm
     from ..parallel.sharding import shard_map_compat
-    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS
+    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS, SEQ_AXIS
 
     tp = _model_axis_size(mesh)
+    S = max(int(seq_shards), 1)
     t, hq, hd = q.shape
     hkv = cache_k_layer.shape[2]
     n = ctx_tables.shape[0]
@@ -173,56 +191,78 @@ def _paged_attention_packed_ctx_tp(
             f"batch axis ({dp}) must divide the pack length ({t}) and the "
             f"slot count ({n})"
         )
+    if S > 1 and cache_k_layer.shape[0] % (dp * S) != 0:
+        raise ValueError(
+            f"batch x seq shards ({dp}x{S}) must divide the block pool "
+            f"({cache_k_layer.shape[0]})"
+        )
     kv_sharded = tp > 1 and hkv % tp == 0
     head_axis = MODEL_AXIS if tp > 1 else None
     kv_head_axis = MODEL_AXIS if kv_sharded else None
     batch_axis = BATCH_AXIS if dp > 1 else None
+    block_axes = tuple(a for a, on in ((BATCH_AXIS, dp > 1),
+                                       (SEQ_AXIS, S > 1)) if on)
+    block_axis = (block_axes if len(block_axes) > 1
+                  else (block_axes[0] if block_axes else None))
     q_spec = P(batch_axis, head_axis, None)
     pk_spec = P(batch_axis, kv_head_axis, None)
-    pool_spec = P(batch_axis, None, kv_head_axis, None)
+    pool_spec = P(block_axis, None, kv_head_axis, None)
     local = functools.partial(
         _paged_attention_packed_ctx_dense, scale=scale,
         logits_soft_cap=logits_soft_cap,
     )
     rows_per = n // dp
 
-    def body(q_l, k_l, v_l, seg, ck, cv, bt, sl):
-        if dp > 1:
-            r = jax.lax.axis_index(BATCH_AXIS)
-            # block ids are global inside the owner replica's contiguous
-            # range: translate by the local slice offset (same rule as the
-            # decode region; -1 padding stays out of range, masked by
-            # ctx_lens)
-            bt = jnp.where(bt >= 0, bt - r * ck.shape[0], -1)
-            # segment ids are global slot+1; this replica's ctx rows start
-            # at slot r * rows_per
-            seg = jnp.where(seg > 0, seg - r * rows_per, 0)
-        if kv_sharded or tp == 1:
-            return local(q_l, k_l, v_l, seg, ck, cv, bt, sl)
+    def narrow_kv(q_l, k_l, v_l, ck, cv):
         # replicated pool/pack kv (GQA, hkv % tp != 0): narrow both the
         # pool AND the pack's fresh kv to this shard's q heads' kv head(s)
         # so the local body sees an aligned GQA problem — the same
-        # alignment paged_attention_decode's inner performs
+        # alignment paged_attention_decode's region performs
+        if kv_sharded or tp == 1:
+            return k_l, v_l, ck, cv
         hq_l = q_l.shape[1]
         i = jax.lax.axis_index(MODEL_AXIS)
         if tp % hkv == 0:
             k0 = i * hkv // tp
-            return local(
-                q_l,
-                jax.lax.dynamic_slice_in_dim(k_l, k0, 1, axis=1),
-                jax.lax.dynamic_slice_in_dim(v_l, k0, 1, axis=1),
-                seg,
-                jax.lax.dynamic_slice_in_dim(ck, k0, 1, axis=2),
-                jax.lax.dynamic_slice_in_dim(cv, k0, 1, axis=2),
-                bt, sl,
-            )
+            return (jax.lax.dynamic_slice_in_dim(k_l, k0, 1, axis=1),
+                    jax.lax.dynamic_slice_in_dim(v_l, k0, 1, axis=1),
+                    jax.lax.dynamic_slice_in_dim(ck, k0, 1, axis=2),
+                    jax.lax.dynamic_slice_in_dim(cv, k0, 1, axis=2))
         g_heads = i * hq_l + jnp.arange(hq_l)
         kv_ids = g_heads * hkv // hq
-        return local(
-            q_l, jnp.take(k_l, kv_ids, axis=1), jnp.take(v_l, kv_ids, axis=1),
-            seg, jnp.take(ck, kv_ids, axis=2), jnp.take(cv, kv_ids, axis=2),
-            bt, sl,
-        )
+        return (jnp.take(k_l, kv_ids, axis=1), jnp.take(v_l, kv_ids, axis=1),
+                jnp.take(ck, kv_ids, axis=2), jnp.take(cv, kv_ids, axis=2))
+
+    def body(q_l, k_l, v_l, seg, ck, cv, bt, sl):
+        if dp > 1 or S > 1:
+            # block ids are global inside the owner shard's contiguous
+            # range: translate by the local slice offset (same rule as the
+            # decode region; -1 padding stays out of range, masked by
+            # ctx_lens).  Under striping only the locally-owned ~1/S of a
+            # row's pages land in [0, nb_local); the partial masks the rest.
+            r = jax.lax.axis_index(BATCH_AXIS) if dp > 1 else 0
+            s = jax.lax.axis_index(SEQ_AXIS) if S > 1 else 0
+            bt = jnp.where(bt >= 0, bt - (r * S + s) * ck.shape[0], -1)
+        if dp > 1:
+            # segment ids are global slot+1; this replica's ctx rows start
+            # at slot r * rows_per
+            r = jax.lax.axis_index(BATCH_AXIS)
+            seg = jnp.where(seg > 0, seg - r * rows_per, 0)
+        k_l, v_l, ck, cv = narrow_kv(q_l, k_l, v_l, ck, cv)
+        if S == 1:
+            return local(q_l, k_l, v_l, seg, ck, cv, bt, sl)
+        include_pack = jax.lax.axis_index(SEQ_AXIS) == 0
+        acc, m, l = _packed_ctx_partial(
+            q_l, k_l, v_l, seg, ck, cv, bt, sl, include_pack,
+            scale=scale, logits_soft_cap=logits_soft_cap)
+        mine = jnp.concatenate([acc, m[..., None], l[..., None]], axis=-1)
+        c = mine
+        # unrolled S-1 collective-permute hops, same carry as decode
+        for _ in range(S - 1):
+            c = qcomm.ring_permute(c, SEQ_AXIS, S)
+            c = _lse_merge_packed(c, mine)
+        out = c[..., :-2] / jnp.maximum(c[..., -1:], 1e-30)
+        return out.astype(q_l.dtype)
 
     return shard_map_compat(
         body, mesh,
@@ -282,9 +322,64 @@ def _paged_attention_packed_ctx_dense(
     return out.astype(q.dtype)
 
 
+def _packed_ctx_partial(
+    q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
+    include_pack, scale=None, logits_soft_cap=None,
+):
+    """Flash-style PARTIAL of the packed-ctx dense body over one seq
+    shard's local pool slice.  ``ctx_tables`` carries locally-translated
+    ids (out-of-range = another shard's page); ``include_pack`` (traced
+    bool) gates the pack's fresh causal keys so exactly one shard charges
+    them.  Returns fp32 ``(acc [T,hq,hd], m [T,hq], l [T,hq])``."""
+    t, hq, hd = q.shape
+    nb, bs, hkv, _ = cache_k_layer.shape
+    n, p = ctx_tables.shape
+    rep = hq // hkv
+    scale = scale if scale is not None else float(hd) ** -0.5
+    seg_row = jnp.clip(segment_ids - 1, 0, n - 1)  # [T] pack row per token
+
+    owned = (ctx_tables >= 0) & (ctx_tables < nb)  # [N, P]
+    safe = jnp.where(owned, ctx_tables, 0)
+    ck = repeat_kv(cache_k_layer[safe].reshape(n, p * bs, hkv, hd), rep)
+    cv = repeat_kv(cache_v_layer[safe].reshape(n, p * bs, hkv, hd), rep)
+    ck_tok = jnp.take(ck, seg_row, axis=0)  # [T, Lc, hq, hd]
+    cv_tok = jnp.take(cv, seg_row, axis=0)
+
+    qf = q.astype(jnp.float32)
+    logits_ctx = jnp.einsum("tqd,tkqd->tqk", qf, ck_tok.astype(jnp.float32))
+    logits_ctx = logits_ctx * scale
+    kp = repeat_kv(k[None], rep)[0].astype(jnp.float32)  # [T, hq, hd]
+    vp = repeat_kv(v[None], rep)[0]
+    logits_pack = jnp.einsum("tqd,kqd->tqk", qf, kp) * scale  # [T, hq, T]
+    if logits_soft_cap is not None:
+        logits_ctx = logits_soft_cap * jnp.tanh(logits_ctx / logits_soft_cap)
+        logits_pack = logits_soft_cap * jnp.tanh(logits_pack / logits_soft_cap)
+
+    own_tok = jnp.take(jnp.repeat(owned, bs, axis=1), seg_row, axis=0)
+    ctx_ok = (jnp.arange(p * bs)[None, :] < ctx_lens[seg_row][:, None]) \
+        & (segment_ids > 0)[:, None] & own_tok  # [T, Lc]
+    idx = jnp.arange(t)
+    pack_ok = (idx[:, None] >= idx[None, :]) \
+        & (segment_ids[:, None] == segment_ids[None, :]) \
+        & include_pack  # [T, T]
+    logits_ctx = jnp.where(ctx_ok[:, None, :], logits_ctx, _NEG_INF)
+    logits_pack = jnp.where(pack_ok[:, None, :], logits_pack, _NEG_INF)
+    m = jnp.maximum(jnp.max(logits_ctx, axis=-1),
+                    jnp.max(logits_pack, axis=-1))  # [T, hq]
+    # keyless rows' exp(_NEG_INF - _NEG_INF) = 1 must not pollute l/acc
+    wc = jnp.where(ctx_ok[:, None, :],
+                   jnp.exp(logits_ctx - m[..., None]), 0.0)
+    wp = jnp.where(pack_ok[:, None, :],
+                   jnp.exp(logits_pack - m[..., None]), 0.0)
+    l = jnp.sum(wc, axis=-1) + jnp.sum(wp, axis=-1)
+    acc = jnp.einsum("tqk,tkqd->tqd", wc, cv_tok.astype(jnp.float32)) \
+        + jnp.einsum("tqk,kqd->tqd", wp, vp.astype(jnp.float32))
+    return acc, m, l
+
+
 def paged_attention_decode(
     q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
-    logits_soft_cap=None, mesh=None, dp: int = 1,
+    logits_soft_cap=None, mesh=None, dp: int = 1, seq_shards: int = 1,
 ):
     """Single-token attention against paged KV.
 
@@ -310,11 +405,25 @@ def paged_attention_decode(
     the pool — and each replica translates its rows' global block ids into
     its local pool range (the engine's slot/block partitioning guarantees a
     replica's sequences only ever hold blocks from its own range).
+
+    ``seq_shards > 1`` (long-context serving, the 3-D batch×seq×model
+    mesh): the pool's block dim subdivides further over ``seq`` and a
+    sequence's pages STRIPE across the seq shards (the allocator
+    round-robins them), so no single shard needs to hold a whole context.
+    Each shard computes a flash-style PARTIAL (running max / sum-exp /
+    weighted-V accumulator) against only its locally-owned pages, then the
+    partials combine with a log-sum-exp ring pass — ``S-1``
+    ``collective_permute`` hops of the packed ``[B, hq, hd+2]`` accumulator
+    (``comm.qcomm.ring_permute``), each hop's merge overlappable with the
+    neighbour's in-flight send.  Every shard converges to the identical
+    full softmax, so the output stays replicated over ``seq``.
     """
-    if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1):
+    if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1
+                             or seq_shards > 1):
         return _paged_attention_decode_tp(
             q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh,
-            dp=dp, scale=scale, logits_soft_cap=logits_soft_cap,
+            dp=dp, seq_shards=seq_shards, scale=scale,
+            logits_soft_cap=logits_soft_cap,
         )
     return _paged_attention_decode_local(
         q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=scale,
@@ -345,34 +454,97 @@ def _model_axis_size(mesh) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(MODEL_AXIS, 1)
 
 
-def kv_pool_pspec(num_kv_heads: int, tp: int, dp: int = 1):
+def kv_pool_pspec(num_kv_heads: int, tp: int, dp: int = 1,
+                  seq_shards: int = 1):
     """PartitionSpec for a per-layer [nb, bs, hkv, hd] block pool: kv heads
     shard on ``model`` when divisible, otherwise the pool replicates (GQA,
     hkv < tp).  ``dp > 1`` (batch×model serve mesh) additionally shards the
     BLOCK dim over ``batch`` — each serving replica owns a contiguous block
-    range, so pool capacity scales with the batch axis."""
+    range, so pool capacity scales with the batch axis.  ``seq_shards > 1``
+    (long-context serving) splits the block dim FURTHER over ``seq``,
+    batch-major: replica ``r``'s contiguous range subdivides into ``S``
+    contiguous seq-shard slices, so global block ``b`` is owned by linear
+    shard ``(b // (nb // (dp*S)))`` = ``r*S + s`` — the layout the in-region
+    block-id translation and the allocator's striping both assume."""
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS
+    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS, SEQ_AXIS
 
     head_axis = MODEL_AXIS if (tp > 1 and num_kv_heads % tp == 0) else None
-    block_axis = BATCH_AXIS if dp > 1 else None
+    block_axes = tuple(
+        a for a, on in ((BATCH_AXIS, dp > 1), (SEQ_AXIS, seq_shards > 1))
+        if on)
+    block_axis = (block_axes if len(block_axes) > 1
+                  else (block_axes[0] if block_axes else None))
     # per-LAYER pool arrays [nb, bs, hkv, hd] (init_paged_cache)
     return P(block_axis, None, head_axis, None)
 
 
+def _lse_merge_packed(a, b):
+    """Log-sum-exp combine of two packed flash partials ``[..., hd+2]``
+    (``concat([acc, m, l], -1)`` — weighted-V accumulator, running max,
+    running sum-exp).  Commutative, so a 2-shard ring converges bit-
+    identically on both ranks; rows with NO keys anywhere stay (0, -1e30,
+    0) and are resolved by the final denominator clamp."""
+    acc_a, m_a, l_a = a[..., :-2], a[..., -2], a[..., -1]
+    acc_b, m_b, l_b = b[..., :-2], b[..., -2], b[..., -1]
+    m = jnp.maximum(m_a, m_b)
+    wa = jnp.exp(m_a - m)
+    wb = jnp.exp(m_b - m)
+    acc = acc_a * wa[..., None] + acc_b * wb[..., None]
+    l = l_a * wa + l_b * wb
+    return jnp.concatenate([acc, m[..., None], l[..., None]], axis=-1)
+
+
+def _paged_attention_decode_partial(
+    q, cache_k_layer, cache_v_layer, block_table, seq_lens, scale=None,
+    logits_soft_cap=None,
+):
+    """Flash-style PARTIAL of the dense decode body over one seq shard's
+    local pool slice: ``block_table`` carries locally-translated ids where
+    entries outside ``[0, nb)`` mark pages another shard owns.  Returns
+    fp32 ``(acc [B,hq,hd], m [B,hq], l [B,hq])`` — merging the S partials
+    with :func:`_lse_merge_packed` reproduces the full softmax exactly."""
+    b, hq, hd = q.shape
+    nb, bs, hkv, _ = cache_k_layer.shape
+    p = block_table.shape[1]
+    owned = (block_table >= 0) & (block_table < nb)  # [B, P]
+    safe = jnp.where(owned, block_table, 0)
+    k = cache_k_layer[safe].reshape(b, p * bs, hkv, hd)
+    v = cache_v_layer[safe].reshape(b, p * bs, hkv, hd)
+    k = repeat_kv(k, hq // hkv)
+    v = repeat_kv(v, hq // hkv)
+    scale = scale if scale is not None else float(hd) ** -0.5
+    logits = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    key_ok = (jnp.arange(p * bs)[None, :] < seq_lens[:, None]) \
+        & jnp.repeat(owned, bs, axis=1)  # [B, p*bs]
+    logits = jnp.where(key_ok[:, None, :], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [B, hq]; _NEG_INF when no local keys
+    w = jnp.exp(logits - m[..., None])
+    # a keyless row's exp(_NEG_INF - _NEG_INF) = 1 must not pollute l/acc
+    w = jnp.where(key_ok[:, None, :], w, 0.0)
+    l = jnp.sum(w, axis=-1)
+    acc = jnp.einsum("bhk,bkhd->bhd", w, v.astype(jnp.float32))
+    return acc, m, l
+
+
 def _paged_attention_decode_tp(
     q, cache_k_layer, cache_v_layer, block_table, seq_lens, mesh, dp=1,
-    scale=None, logits_soft_cap=None,
+    seq_shards=1, scale=None, logits_soft_cap=None,
 ):
     import functools
 
     from jax.sharding import PartitionSpec as P
 
+    from ..comm import qcomm
     from ..parallel.sharding import shard_map_compat
-    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS
+    from ..parallel.topology import BATCH_AXIS, MODEL_AXIS, SEQ_AXIS
 
     tp = _model_axis_size(mesh)
+    S = max(int(seq_shards), 1)
     b, hq, hd = q.shape
     hkv = cache_k_layer.shape[2]
     if tp > 1 and hq % tp != 0:
@@ -383,54 +555,79 @@ def _paged_attention_decode_tp(
         raise ValueError(
             f"batch axis ({dp}) must divide the slot count ({b})"
         )
+    if S > 1 and cache_k_layer.shape[0] % (dp * S) != 0:
+        raise ValueError(
+            f"batch x seq shards ({dp}x{S}) must divide the block pool "
+            f"({cache_k_layer.shape[0]})"
+        )
     kv_sharded = tp > 1 and hkv % tp == 0
     kv_head_axis = MODEL_AXIS if kv_sharded else None
     head_axis = MODEL_AXIS if tp > 1 else None
     batch_axis = BATCH_AXIS if dp > 1 else None
+    block_axes = tuple(a for a, on in ((BATCH_AXIS, dp > 1),
+                                       (SEQ_AXIS, S > 1)) if on)
+    block_axis = (block_axes if len(block_axes) > 1
+                  else (block_axes[0] if block_axes else None))
     q_spec = P(batch_axis, head_axis, None)
-    kv_spec = P(batch_axis, None, kv_head_axis, None)
+    kv_spec = P(block_axis, None, kv_head_axis, None)
     local = functools.partial(
         _paged_attention_decode_local, scale=scale, logits_soft_cap=logits_soft_cap
     )
-    if kv_sharded or tp == 1:
-        # hq/hkv is integral, so the kv heads of q shard i are exactly kv
-        # shard i — local GQA ratio is preserved and no gather is needed
-        inner = local
-    else:
-        def inner(q_l, ck, cv, bt, sl):
-            # replicated pool (hkv < tp): each shard narrows the pool to its
-            # q heads' kv head(s) so the local body sees an aligned GQA
-            # problem — repeat_kv(hq_local // hkv) would be 0 when
-            # hkv > hq_local.  (A block-dim-sharded flash-decoding split
-            # would avoid the pool copy entirely; head narrowing keeps the
-            # paged kernel's per-page DMA untouched.)
-            import jax as _jax
-            import jax.numpy as _jnp
 
-            hq_l = q_l.shape[1]
-            i = _jax.lax.axis_index(MODEL_AXIS)
-            if tp % hkv == 0:
-                # shard chunks nest inside kv groups: exactly ONE kv head per
-                # shard — one contiguous O(pool/hkv) slice, not a full-pool
-                # gather
-                ck_l = _jax.lax.dynamic_slice_in_dim(ck, i * hkv // tp, 1, axis=2)
-                cv_l = _jax.lax.dynamic_slice_in_dim(cv, i * hkv // tp, 1, axis=2)
-                return local(q_l, ck_l, cv_l, bt, sl)
-            g_heads = i * hq_l + _jnp.arange(hq_l)
-            kv_ids = g_heads * hkv // hq
-            return local(q_l, _jnp.take(ck, kv_ids, axis=2),
-                         _jnp.take(cv, kv_ids, axis=2), bt, sl)
+    def narrow_kv(q_l, ck, cv):
+        # replicated pool (hkv < tp): each shard narrows the pool to its
+        # q heads' kv head(s) so the local body sees an aligned GQA
+        # problem — repeat_kv(hq_local // hkv) would be 0 when
+        # hkv > hq_local.  (A block-dim-sharded flash-decoding split
+        # would avoid the pool copy entirely; head narrowing keeps the
+        # paged kernel's per-page DMA untouched.)
+        if kv_sharded or tp == 1:
+            # hq/hkv is integral, so the kv heads of q shard i are exactly
+            # kv shard i — local GQA ratio preserved, no gather needed
+            return ck, cv
+        hq_l = q_l.shape[1]
+        i = jax.lax.axis_index(MODEL_AXIS)
+        if tp % hkv == 0:
+            # shard chunks nest inside kv groups: exactly ONE kv head per
+            # shard — one contiguous O(pool/hkv) slice, not a full-pool
+            # gather
+            k0 = i * hkv // tp
+            return (jax.lax.dynamic_slice_in_dim(ck, k0, 1, axis=2),
+                    jax.lax.dynamic_slice_in_dim(cv, k0, 1, axis=2))
+        g_heads = i * hq_l + jnp.arange(hq_l)
+        kv_ids = g_heads * hkv // hq
+        return (jnp.take(ck, kv_ids, axis=2), jnp.take(cv, kv_ids, axis=2))
 
     def body(q_l, ck, cv, bt, sl):
-        if dp > 1:
-            # each batch replica's table rows carry GLOBAL block ids inside
-            # its own contiguous range (the allocator partitions the pool);
-            # the local pool slice starts at r * nb_local, so ids translate
-            # by a constant offset.  -1 padding stays out of range and is
-            # masked by seq_lens, exactly like the single-replica body.
-            r = jax.lax.axis_index(BATCH_AXIS)
-            bt = jnp.where(bt >= 0, bt - r * ck.shape[0], -1)
-        return inner(q_l, ck, cv, bt, sl)
+        if dp > 1 or S > 1:
+            # each shard's local pool slice starts at (r*S + s) * nb_local
+            # of the global (batch-major) block range, so a table row's
+            # GLOBAL block ids translate by a constant offset.  Under dp
+            # the allocator's replica affinity guarantees every id lands
+            # in-range; under seq striping only ~1/S of a row's pages do —
+            # the rest fall outside [0, nb_local) and the partial masks
+            # them as another shard's work.  -1 padding stays out of range
+            # either way.
+            r = jax.lax.axis_index(BATCH_AXIS) if dp > 1 else 0
+            s = jax.lax.axis_index(SEQ_AXIS) if S > 1 else 0
+            bt = jnp.where(bt >= 0, bt - (r * S + s) * ck.shape[0], -1)
+        ck, cv = narrow_kv(q_l, ck, cv)
+        if S == 1:
+            return local(q_l, ck, cv, bt, sl)
+        acc, m, l = _paged_attention_decode_partial(
+            q_l, ck, cv, bt, sl, scale=scale,
+            logits_soft_cap=logits_soft_cap)
+        mine = jnp.concatenate([acc, m[..., None], l[..., None]], axis=-1)
+        c = mine
+        # log-sum-exp ring: a PYTHON loop, not a scan, so the compiled
+        # module carries exactly S-1 collective-permute hops per layer (the
+        # HLO auditor counts them) and XLA can overlap each hop's send with
+        # the resident merge.  Carry: the packed [B, hq_l, hd+2] partial.
+        for _ in range(S - 1):
+            c = qcomm.ring_permute(c, SEQ_AXIS, S)
+            c = _lse_merge_packed(c, mine)
+        out = c[..., :-2] / jnp.maximum(c[..., -1:], 1e-30)
+        return out.astype(q_l.dtype)
 
     return shard_map_compat(
         body, mesh,

@@ -52,16 +52,33 @@ class BlockedAllocator:
     ``available_blocks`` (free + evictable cached).
     """
 
-    def __init__(self, num_blocks: int, start: int = 0):
+    def __init__(self, num_blocks: int, start: int = 0, stripes: int = 1):
         if num_blocks < 1:
             raise ValueError(f"need at least one block, got {num_blocks}")
+        if stripes < 1 or num_blocks % stripes:
+            raise ValueError(
+                f"stripes ({stripes}) must be >= 1 and divide the pool "
+                f"({num_blocks} blocks)")
         # ``start``: first GLOBAL block id this allocator owns.  Replica-
         # partitioned pools (2-D batch x model serve mesh) run one allocator
         # per contiguous range so block ids stay global — device block
         # tables and prefix-cache keys never need translation host-side.
         self._start = start
         self._num_blocks = num_blocks
-        self._free: List[int] = list(range(start, start + num_blocks))
+        # ``stripes`` (3-D batch x seq x model serve mesh): the pool splits
+        # into ``stripes`` CONTIGUOUS sub-ranges — stripe s owns global ids
+        # [start + s*size, start + (s+1)*size) — mirroring the device pool's
+        # seq-axis slices, and ``allocate(first_pos=...)`` round-robins a
+        # sequence's chain over them so chain position i's page provably
+        # lives on seq shard i % stripes (balanced per-hop ring work, and a
+        # long sequence fits iff the AGGREGATE pool fits it).
+        self._stripes = stripes
+        self._stripe_size = num_blocks // stripes
+        self._free: List[List[int]] = [
+            list(range(start + s * self._stripe_size,
+                       start + (s + 1) * self._stripe_size))
+            for s in range(stripes)
+        ]
         # indexed by (block - start): ids stay global, storage stays local
         self._refs: List[int] = [0] * num_blocks
         self._key_of: Dict[int, object] = {}  # block -> content key
@@ -74,7 +91,20 @@ class BlockedAllocator:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
+
+    @property
+    def stripes(self) -> int:
+        return self._stripes
+
+    def stripe_of(self, block: int) -> int:
+        """Which stripe (seq shard) owns ``block``."""
+        self._check(block)
+        return (block - self._start) // self._stripe_size
+
+    def _push_free(self, block: int) -> None:
+        return self._free[
+            (block - self._start) // self._stripe_size].append(block)
 
     @property
     def cached_blocks(self) -> int:
@@ -82,8 +112,8 @@ class BlockedAllocator:
 
     @property
     def available_blocks(self) -> int:
-        """Immediately allocatable: free list + evictable cached blocks."""
-        return len(self._free) + len(self._lru)
+        """Immediately allocatable: free lists + evictable cached blocks."""
+        return self.free_blocks + len(self._lru)
 
     @property
     def total_blocks(self) -> int:
@@ -97,27 +127,62 @@ class BlockedAllocator:
         if not self._start <= block < self._start + self._num_blocks:
             raise ValueError(f"invalid block id {block}")
 
-    def allocate(self, n: int) -> List[int]:
-        if n > self.available_blocks:
+    def can_allocate(self, n: int, first_pos: int = 0, hold=()) -> bool:
+        """Whether ``allocate(n, first_pos)`` would succeed.  Under striping
+        aggregate headroom is NOT sufficient: run entry ``j`` must come from
+        stripe ``(first_pos + j) % stripes`` specifically.  ``hold``: cached-
+        LRU blocks an admission is about to revive (prefix-matched blocks at
+        refcount 0) — charged as unavailable, since revival pulls them out
+        of the evictable pool before the fresh allocation runs."""
+        held = set(hold)
+        if self._stripes == 1:
+            return n <= self.available_blocks - len(held & self._lru.keys())
+        need = [0] * self._stripes
+        for j in range(n):
+            need[(first_pos + j) % self._stripes] += 1
+        lru_per = [0] * self._stripes
+        for b in self._lru:
+            if b not in held:
+                lru_per[(b - self._start) // self._stripe_size] += 1
+        return all(len(self._free[s]) + lru_per[s] >= need[s]
+                   for s in range(self._stripes))
+
+    def allocate(self, n: int, first_pos: int = 0) -> List[int]:
+        """Hand out ``n`` fresh blocks.  ``first_pos``: the chain position
+        of the run's first block — run entry ``j`` is drawn from stripe
+        ``(first_pos + j) % stripes`` so a sequence's pages round-robin
+        across the seq shards (the identity at ``stripes == 1``)."""
+        if not self.can_allocate(n, first_pos):
             raise RuntimeError(
                 f"cannot allocate {n} blocks ({self.available_blocks} available)"
             )
         out: List[int] = []
-        while len(out) < n:
-            if self._free:
-                b = self._free.pop()  # LIFO: O(1), and recently-freed pages
-            else:  # are the warmest
-                b = self._evict_one()
+        for j in range(n):
+            s = (first_pos + j) % self._stripes
+            if self._free[s]:
+                b = self._free[s].pop()  # LIFO: O(1), and recently-freed
+            else:  # pages are the warmest
+                b = self._evict_one(s)
             self._refs[b - self._start] = 1
             out.append(b)
         return out
 
-    def _evict_one(self) -> int:
+    def _evict_one(self, stripe: Optional[int] = None) -> int:
         """Drop the least-recently-used cached block, cascading its key AND
         every cached descendant's key: a descendant's key names this block
         id as its parent, and once the id is reused for other content a
-        lookup through it would serve wrong pages."""
-        b, _ = self._lru.popitem(last=False)
+        lookup through it would serve wrong pages.  ``stripe``: restrict to
+        the LRU-oldest block of that stripe (striped pools evict within the
+        stripe the allocation run needs)."""
+        if stripe is None or self._stripes == 1:
+            b, _ = self._lru.popitem(last=False)
+        else:
+            b = next((x for x in self._lru
+                      if (x - self._start) // self._stripe_size == stripe),
+                     None)
+            if b is None:
+                raise RuntimeError(f"no evictable blocks in stripe {stripe}")
+            del self._lru[b]
         self._drop_key(b)
         self.evictions += 1
         return b
@@ -137,7 +202,7 @@ class BlockedAllocator:
             # the free list (the root itself is the caller's to hand out)
             if x != root and self._refs[x - self._start] == 0 and x in self._lru:
                 del self._lru[x]
-                self._free.append(x)
+                self._push_free(x)
 
     def ref(self, block: int) -> None:
         """Take a reference on an allocated or cached block."""
@@ -168,7 +233,7 @@ class BlockedAllocator:
                     self._lru[b] = None
                     self._lru.move_to_end(b)
                 else:
-                    self._free.append(b)
+                    self._push_free(b)
 
     def register(self, block: int, key, parent: Optional[int] = None) -> bool:
         """Publish ``block`` as holding the content ``key`` (a FULL block),
@@ -203,7 +268,7 @@ class BlockedAllocator:
             # a de-keyed block is dead cache: straight to the free list
             # (audit forbids unkeyed blocks in the LRU)
             del self._lru[block]
-            self._free.append(block)
+            self._push_free(block)
 
     def lookup(self, key) -> Optional[int]:
         """Block currently holding content ``key`` (caller must ``ref`` it)."""
@@ -213,7 +278,12 @@ class BlockedAllocator:
         """Invariant check for tests: every block is in exactly one of
         {free, cached LRU, active (refcount > 0)} and the key maps agree."""
         owned = range(self._start, self._start + self._num_blocks)
-        free = set(self._free)
+        for s, fl in enumerate(self._free):
+            for b in fl:
+                assert (b - self._start) // self._stripe_size == s, (
+                    f"block {b} on stripe {s}'s free list but owned by "
+                    f"stripe {(b - self._start) // self._stripe_size}")
+        free = {b for fl in self._free for b in fl}
         lru = set(self._lru)
         active = {b for b in owned if self._refs[b - self._start] > 0}
         assert not (free & lru), f"free/lru overlap: {free & lru}"
@@ -306,6 +376,13 @@ class _AllocatorGroupView:
     def key_of(self, block: int):
         return self._of(block).key_of(block)
 
+    @property
+    def stripes(self) -> int:
+        return self._allocators[0].stripes
+
+    def stripe_of(self, block: int) -> int:
+        return self._of(block).stripe_of(block)
+
     def audit(self) -> None:
         for a in self._allocators:
             a.audit()
@@ -325,12 +402,18 @@ class StateManager:
     """
 
     def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
-                 enable_prefix_caching: bool = False, replicas: int = 1):
+                 enable_prefix_caching: bool = False, replicas: int = 1,
+                 seq_shards: int = 1):
         # ``replicas`` (2-D batch x model serve mesh): slots AND blocks
         # partition into ``replicas`` contiguous groups — group r's slots
         # only ever hold blocks from group r's range, so the device pool
         # can shard its block dim over the batch axis and each mesh replica
         # resolves its rows' block ids inside its local pool slice.
+        # ``seq_shards`` (3-D batch x seq x model): each replica's range
+        # further stripes into ``seq_shards`` contiguous sub-ranges, and a
+        # sequence's chain round-robins across them — replica r stripe s is
+        # exactly linear mesh shard r*S + s of the device pool's block dim,
+        # so the kernel-side global->local translation needs no host help.
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if num_blocks % replicas or max_seqs % replicas:
@@ -338,12 +421,21 @@ class StateManager:
                 f"num_blocks ({num_blocks}) and max_seqs ({max_seqs}) must "
                 f"both divide into {replicas} serve replicas"
             )
+        if seq_shards < 1:
+            raise ValueError(f"seq_shards must be >= 1, got {seq_shards}")
+        if (num_blocks // replicas) % seq_shards:
+            raise ValueError(
+                f"each replica's pool ({num_blocks // replicas} blocks) "
+                f"must divide into {seq_shards} seq shards"
+            )
         self.block_size = block_size
         self.replicas = replicas
+        self.seq_shards = seq_shards
         self._blocks_per = num_blocks // replicas
         self._slots_per = max_seqs // replicas
         self.allocators = [
-            BlockedAllocator(self._blocks_per, start=r * self._blocks_per)
+            BlockedAllocator(self._blocks_per, start=r * self._blocks_per,
+                             stripes=seq_shards)
             for r in range(replicas)
         ]
         # single-replica managers expose the one allocator object unchanged
@@ -455,7 +547,10 @@ class StateManager:
             a = self.allocators[r]
             matched, lru = (self._probe_match(tokens, a) if probe
                             else (0, []))
-            if a.available_blocks < (blocks - matched) + len(lru):
+            # striping-aware: fresh blocks land at chain positions
+            # matched..blocks-1 and each must fit its owning stripe
+            if not a.can_allocate(blocks - matched, first_pos=matched,
+                                  hold=lru):
                 continue
             key = (matched, a.available_blocks)
             if best_key is None or key > best_key:
@@ -505,7 +600,13 @@ class StateManager:
                                 if probe else (0, []))
                 fresh_lru = [b for b in lru if b not in revived]
                 need = (blocks - matched) + len(fresh_lru)
-                if avail[r] < need:
+                # the aggregate running counter catches cross-admission
+                # pressure; the per-stripe probe (against CURRENT state —
+                # one more un-modeled corner of the kind the docstring
+                # already concedes) catches a full stripe hiding behind
+                # aggregate headroom
+                if avail[r] < need or not self.allocators[r].can_allocate(
+                        blocks - matched, first_pos=matched, hold=fresh_lru):
                     continue
                 key = (matched, avail[r])
                 if best_key is None or key > best_key:
@@ -587,7 +688,8 @@ class StateManager:
                 # only growth consults the injector: a no-growth call must
                 # stay infallible (retry loops rely on it converging)
                 self.faults.maybe_raise("alloc_exhaustion", uids=(seq.uid,))
-            seq.blocks.extend(self._alloc_of(seq).allocate(n))
+            seq.blocks.extend(self._alloc_of(seq).allocate(
+                n, first_pos=len(seq.blocks)))
 
     def ensure_writable(self, seq: SequenceDescriptor, pos: int) -> None:
         """Copy-on-write guard: the page holding token position ``pos`` must
@@ -602,7 +704,7 @@ class StateManager:
         b = seq.blocks[i]
         if alloc.refcount(b) <= 1:
             return
-        [new] = alloc.allocate(1)
+        [new] = alloc.allocate(1, first_pos=i)  # stay in position i's stripe
         if self.cow_hook is not None:
             self.cow_hook(b, new)
         alloc.free([b])

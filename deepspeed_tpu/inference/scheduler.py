@@ -126,12 +126,22 @@ class SubmitResult:
     ``RETRY_LATER``: the scheduler's drain-rate estimate of when a resubmit
     has a chance (queue excess over the shed-exit watermark x the recent
     tick duration) — clients back off proportionally instead of
-    blind-polling."""
+    blind-polling.
+
+    ``budget_blocks``/``budget_scope`` accompany ``REJECT_POOL_IMPOSSIBLE``:
+    the KV-block budget the request was actually judged against and what
+    that budget spans (``"replica_pool"``, or
+    ``"replica_pool(aggregate over N seq shards)"`` on a seq-sharded mesh)
+    — so a caller can distinguish "too long for THIS config" (a wider
+    ``seq_shards``/``num_blocks`` deployment could serve it) from "too
+    long ever" (``REJECT_PROMPT_TOO_LONG``, past ``max_seq_len``)."""
 
     uid: int
     reason: str
     detail: str = ""
     retry_after_ms: Optional[float] = None
+    budget_blocks: Optional[int] = None
+    budget_scope: str = ""
 
     @property
     def accepted(self) -> bool:
@@ -328,13 +338,20 @@ class ServeScheduler:
         max_len = min(len(tokens) + sampling.max_new_tokens, eng.max_seq_len)
         blocks = -(-max_len // eng.block_size)
         # a sequence lives entirely inside ONE replica's block range, so the
-        # feasibility bound is the per-replica pool, not the aggregate
+        # feasibility bound is the per-replica pool, not the cross-replica
+        # aggregate.  A replica's pool DOES aggregate its seq shards (the
+        # sequence stripes across all S slices), so the budget here is S x
+        # one slice — bigger contexts fit by raising seq_shards.
         pool = eng.mgr.allocator.total_blocks // eng.mgr.replicas
         if blocks > pool:
+            scope = ("replica_pool" if eng.mgr.seq_shards <= 1 else
+                     f"replica_pool(aggregate over {eng.mgr.seq_shards} "
+                     f"seq shards)")
             return SubmitResult(
                 uid, REJECT_POOL_IMPOSSIBLE,
                 f"prompt + max_new_tokens needs {blocks} KV blocks; a "
-                f"replica's pool only has {pool}",
+                f"replica's pool only has {pool} ({scope})",
+                budget_blocks=pool, budget_scope=scope,
             )
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         if not self._running and not self.waiting:
@@ -538,10 +555,14 @@ class ServeScheduler:
         blocks = -(-max_len // eng.block_size)
         pool = eng.mgr.allocator.total_blocks // eng.mgr.replicas
         if blocks > pool:
+            scope = ("replica_pool" if eng.mgr.seq_shards <= 1 else
+                     f"replica_pool(aggregate over {eng.mgr.seq_shards} "
+                     f"seq shards)")
             return SubmitResult(
                 uid, REJECT_POOL_IMPOSSIBLE,
                 f"adopted request needs {blocks} KV blocks at max length; "
-                f"a replica's pool only has {pool}",
+                f"a replica's pool only has {pool} ({scope})",
+                budget_blocks=pool, budget_scope=scope,
             )
         triple = (sampling.temperature, sampling.top_k, sampling.top_p)
         if not self._running and not self.waiting:

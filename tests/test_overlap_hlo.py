@@ -12,6 +12,8 @@ done-before-start scan back-edges), so an XLA print-format change is a
 one-module fix instead of a test-suite breakage (the PR 9 class of fix
 stays fixed).
 """
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,9 +21,36 @@ import pytest
 
 from deepspeed_tpu.analysis import check_payload_dtypes, parse_scheduled_hlo
 
+def _probe_tpu_aot(timeout_s: float) -> bool:
+    """Whether the TPU AOT compiler can initialize HERE, bounded in time.
+
+    ``get_topology_desc(platform="tpu")`` reaches libtpu init, and on a
+    box where the GCP metadata service is BLACKHOLED (requests hang
+    instead of failing) that init retries each metadata variable for
+    minutes while holding the GIL — an unbounded collection-time hang no
+    ``except`` can catch.  Probing in a subprocess turns that failure
+    mode back into the skip the except-clause below always produced."""
+    import subprocess
+    import sys
+
+    try:
+        return subprocess.run(
+            [sys.executable, "-c",
+             "from jax.experimental import topologies\n"
+             "topologies.get_topology_desc(platform='tpu', "
+             "topology_name='v5e:2x4')"],
+            timeout=timeout_s, capture_output=True,
+        ).returncode == 0
+    except Exception:  # pragma: no cover - environment-dependent
+        return False
+
+
 try:
     from jax.experimental import topologies
 
+    if not _probe_tpu_aot(
+            float(os.environ.get("DSTPU_TPU_AOT_PROBE_TIMEOUT_S", "60"))):
+        raise RuntimeError("TPU AOT topology probe failed or timed out")
     _TOPO = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x4")
 except Exception as e:  # pragma: no cover - environment-dependent
     _TOPO = None
