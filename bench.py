@@ -1025,17 +1025,17 @@ def serving_main(quant=None, spec=False, smoke=False):
         n_req, sys_len, sfx_len, max_new = 8, 512, 64, 16
         serve_blocks = 96
 
-    def serve_engine(telemetry=False):
+    def serve_engine(telemetry=False, fused=None):
         return InferenceEngineV2(
             sparams, scfg, max_seqs=8, num_blocks=serve_blocks, block_size=32,
             max_seq_len=704, prefill_buckets=(64, 128, 256),
             prefill_budget=256, prefill_chunk=256, enable_prefix_caching=True,
-            telemetry=telemetry,
+            telemetry=telemetry, fused_serving=fused,
         )
 
     serve_samp = SamplingParams(temperature=0.0, max_new_tokens=max_new)
 
-    def run_serve(telemetry):
+    def run_serve(telemetry, fused=None):
         """One full shared-prefix arrival run on a fresh engine.  Fresh
         numpy rng + seeded engine PRNG per run, so the telemetry-on run and
         its disabled twin see byte-identical workloads."""
@@ -1045,7 +1045,7 @@ def serving_main(quant=None, spec=False, smoke=False):
             u: sys_prompt + rng.integers(1, scfg.vocab_size, sfx_len).tolist()
             for u in range(1, n_req + 1)
         }
-        seng = serve_engine(telemetry)
+        seng = serve_engine(telemetry, fused=fused)
         sched = seng.scheduler
         # shape REHEARSAL instead of single-request warmups: packed prefill
         # dispatch shapes vary with the number of packed entries, so only
@@ -1113,6 +1113,36 @@ def serving_main(quant=None, spec=False, smoke=False):
     print(format_percentile_table(
         pct, title="serve latency percentiles (telemetry twin)"))
 
+    # --- prefill-pack kernel-vs-dense A/B gate: the telemetry twin above
+    # serves with the engine's auto fused policy (the Pallas ctx-attention
+    # kernel on TPU), and this third run pins fused_serving=False — the jnp
+    # dense packed-ctx body — on the byte-identical workload.  The
+    # serve/prefill_pack_ms span is the kernel's own A/B lever; off-TPU
+    # both lanes run the dense body (dispatch needs on_tpu or interpret),
+    # so ctx_kernel_active=false marks the speedup as deferred, not free.
+    from deepspeed_tpu.ops.pallas import ctx_attention as _ck
+
+    rd = run_serve(telemetry=True, fused=False)
+    if not on_tpu:
+        assert rd["results"] == results, \
+            "pinned-dense serve diverged from the fused-policy run"
+    rd["seng"].telemetry.flush()
+    pct_dense = percentile_summary(rd["seng"].telemetry.registry,
+                                   ("serve/prefill_pack_ms",))
+    pack_fused = pct.get("prefill_pack_ms", {}).get("p50")
+    pack_dense = pct_dense.get("prefill_pack_ms", {}).get("p50")
+    ctx_kernel_active = bool(on_tpu or _ck._INTERPRET)
+    pack_ab = dict(
+        prefill_pack_ms_p50_fused=pack_fused,
+        prefill_pack_ms_p50_dense=pack_dense,
+        prefill_pack_dense_over_fused=(
+            round(pack_dense / pack_fused, 2)
+            if pack_fused and pack_dense else None),
+        ctx_kernel_active=ctx_kernel_active,
+        dense_token_identical=(rd["results"] == results),
+    )
+    print(f"prefill-pack A/B (fused vs pinned dense): {pack_ab}")
+
     hit_rate = (seng.mgr.cached_prompt_tokens - r["cached0"]) / max(
         1, seng.mgr.prompt_tokens_total - r["prompt0"]
     )
@@ -1148,6 +1178,7 @@ def serving_main(quant=None, spec=False, smoke=False):
             "cold_vs_hit_token_identical": token_identical,
             "latency_percentiles": pct,
             "telemetry_disabled_twin_stats_equal": twin_equal,
+            "prefill_pack_ab": pack_ab,
         },
     }))
 
@@ -2066,6 +2097,79 @@ def quant_kernels_main():
             "fp6_fused_vs_bf16_mean": agg("fp6_fused_vs_bf16"),
             "rows": rows,
         },
+    }))
+
+
+def attn_kernels_main():
+    """Packed-ctx attention microbench (`python bench.py --attn-kernels`):
+    the flash-style Pallas kernel (ops/pallas/ctx_attention.py) vs the jnp
+    dense body it replaces, at 410M/8B prefill-over-cached-context shapes.
+    The number that matters is effective KV bandwidth: the kernel streams
+    only the LIVE context pages (plus the pack once), while the dense body
+    gathers the full table width and materializes O(T * P * bs) logits —
+    so kernel GB/s is computed over live-context bytes and dense GB/s over
+    the gathered bytes it actually moves.  Off-TPU this smoke-runs a tiny
+    shape through the kernel interpreter (timings measure the interpreter,
+    not the chip — shape/dispatch coverage only)."""
+    from deepspeed_tpu.inference.paged import _paged_attention_packed_ctx_dense
+    from deepspeed_tpu.ops.pallas import ctx_attention as ckm
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # (name, T pack, segments, ctx tokens/seg, bs, hq, hkv, hd)
+        shape_sets = [
+            ("410m", 256, 4, 1024, 32, 16, 16, 64),
+            ("8b", 256, 4, 2048, 32, 32, 8, 128),
+        ]
+    else:
+        ckm.set_interpret(True)
+        shape_sets = [("smoke", 32, 4, 48, 8, 8, 2, 32)]
+
+    rows = []
+    rng = np.random.default_rng(0)
+    for name, t, n, ctx, bs, hq, hkv, hd in shape_sets:
+        pages_per = -(-ctx // bs)
+        p = pages_per + 2  # table wider than the live context (engine-like)
+        nb = n * pages_per + 8
+        isz = 4 if not on_tpu else 2
+        dt = jnp.float32 if not on_tpu else jnp.bfloat16
+        q = jnp.asarray(rng.normal(size=(t, hq, hd)), dt)
+        kp = jnp.asarray(rng.normal(size=(t, hkv, hd)), dt)
+        vp = jnp.asarray(rng.normal(size=(t, hkv, hd)), dt)
+        ckl = jnp.asarray(rng.normal(size=(nb, bs, hkv, hd)), dt)
+        cvl = jnp.asarray(rng.normal(size=(nb, bs, hkv, hd)), dt)
+        seg = jnp.asarray(np.repeat(np.arange(1, n + 1), t // n), jnp.int32)
+        tables = np.full((n, p), -1, np.int32)
+        perm = rng.permutation(nb)
+        for i in range(n):
+            tables[i, :pages_per] = perm[i * pages_per:(i + 1) * pages_per]
+        tables = jnp.asarray(tables)
+        lens = jnp.full((n,), ctx, jnp.int32)
+        # deliberately misaligned verify-style start on one segment
+        lens = lens.at[0].set(ctx - bs // 2)
+        kfn = jax.jit(ckm.paged_attention_packed_ctx_kernel)
+        dfn = jax.jit(_paged_attention_packed_ctx_dense)
+        t_k = _time_jit(kfn, q, kp, vp, seg, ckl, cvl, tables, lens)
+        t_d = _time_jit(dfn, q, kp, vp, seg, ckl, cvl, tables, lens)
+        live_bytes = 2 * sum(-(-int(l) // bs) * bs for l in lens) \
+            * hkv * hd * isz + 3 * t * hq * hd * isz
+        dense_bytes = 2 * n * p * bs * hkv * hd * isz + 3 * t * hq * hd * isz
+        rows.append({
+            "model": name, "pack": t, "segments": n, "ctx_tokens": ctx,
+            "table_pages": p, "kernel_us": round(1e6 * t_k, 1),
+            "dense_us": round(1e6 * t_d, 1),
+            "kernel_vs_dense": round(t_d / t_k, 2),
+            "kernel_gb_s": round(live_bytes / t_k / 1e9, 1),
+            "dense_gb_s": round(dense_bytes / t_d / 1e9, 1),
+        })
+    if not on_tpu:
+        ckm.set_interpret(False)
+    print(json.dumps({
+        "metric": "ctx_attention_kernel_vs_dense_speedup_mean",
+        "value": round(float(np.mean([r["kernel_vs_dense"] for r in rows])), 2),
+        "unit": "x",
+        "vs_baseline": None,
+        "extra": {"interpret_smoke": not on_tpu, "rows": rows},
     }))
 
 
@@ -2991,6 +3095,8 @@ if __name__ == "__main__":
     elif "--serve8b" in sys.argv:
         serve8b_main(quant=q or "int8", spec=spec, tp=tp,
                      quant_comm=quant_comm)
+    elif "--attn-kernels" in sys.argv:
+        attn_kernels_main()
     elif "--quant-kernels" in sys.argv:
         quant_kernels_main()
     else:

@@ -97,6 +97,10 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "inference/model_runner.py": {"*"},
     "inference/sampling.py": {"*"},
     "inference/paged.py": {"*"},
+    # the packed-ctx Pallas kernel's dispatch + wrapper (ISSUE 19): rides
+    # every chunked prefill / prefix-hit / spec-verify forward, so a host
+    # sync here stalls the hottest prefill path in the engine
+    "ops/pallas/ctx_attention.py": {"*"},
     # seq-striped allocation bookkeeping (ISSUE 18): these run under the
     # scheduler's intake lock on every admit/grow/evict — pure host list
     # arithmetic; a device sync or raw collective here would stall every
@@ -117,6 +121,7 @@ GLOBAL_BASELINE: Set[Tuple[str, str]] = {
     ("ops/pallas/flash_kernel.py", "_BLOCK_K"),
     ("ops/pallas/flash_kernel.py", "_BLOCK_Q_BWD"),
     ("ops/pallas/flash_kernel.py", "_BLOCK_K_BWD"),
+    ("ops/pallas/ctx_attention.py", "_INTERPRET"),
     ("ops/pallas/fused_adam.py", "_INTERPRET"),
     ("ops/pallas/paged_attention.py", "_INTERPRET"),
     ("ops/pallas/quant_kernel.py", "_INTERPRET"),

@@ -224,9 +224,22 @@ def predict_serve_cost(cand: Dict[str, Any], model_cfg,
     # KV-streaming bandwidth per token by the slice count.  Callers that
     # pass no ``num_blocks`` in ``base`` (format-ordering comparisons)
     # charge nothing here, as before.
-    t += kv_pool_bytes(model_cfg, base.get("num_blocks", 0),
-                       base.get("block_size", 32)) / (dp * sq) \
+    kv_read = kv_pool_bytes(model_cfg, base.get("num_blocks", 0),
+                            base.get("block_size", 32)) / (dp * sq) \
         / (consts.hbm_gbps * 1e9)
+    t += kv_read
+    # prefill/verify attention KV traffic (the packed-ctx kernel's own
+    # roofline: pages touched x bytes/page at the pool's element format,
+    # which is what kv_pool_bytes already encodes).  A spec-verify tick
+    # re-streams each live sequence's cached context pages through the
+    # ctx-attention kernel ON TOP of the decode read above, and chunked
+    # prefill co-scheduled with decode touches roughly half the live pool
+    # per tick — without these terms long-context spec/chunked candidates
+    # rank as if verify attention were free.
+    if cand.get("spec"):
+        t += kv_read
+    if cand.get("prefill_chunk"):
+        t += 0.5 * kv_read
     if tp > 1 or sq > 1:
         plan = serving_tick_plan(
             model_cfg, B, tp, cand.get("quant_comm", "none"),

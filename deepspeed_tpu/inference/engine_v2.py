@@ -263,6 +263,10 @@ class InferenceEngineV2:
         # Constructor arg wins; else the serve config block; None = auto
         # (fused kernel whenever local shapes qualify — including under TP,
         # where the kernels now run inside manual shard_map regions).
+        # False additionally pins the packed-ctx attention (prefill/verify)
+        # to its jnp dense body instead of the Pallas ctx kernel
+        # (ops/pallas/ctx_attention.py) — the kernel-vs-dense A/B lever the
+        # serving bench and parity tests use.
         self.fused_serving = (fused_serving if fused_serving is not None
                               else self.serve.fused_serving)
         # quantized-collective transport for the row-parallel TP psums

@@ -303,7 +303,7 @@ def prefill_packed_ctx(
         attn = paged_attention_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
-            mesh=mesh, dp=dp, seq_shards=seq_shards,
+            mesh=mesh, dp=dp, seq_shards=seq_shards, ctx=ctx,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)
@@ -381,7 +381,7 @@ def verify_packed_ctx(
         attn = paged_attention_packed_ctx(
             q[0], k[0], v[0], segment_ids, new_ck[l], new_cv[l],
             ctx_tables, ctx_lens, logits_soft_cap=cfg.logits_soft_cap,
-            mesh=mesh, dp=dp, seq_shards=seq_shards,
+            mesh=mesh, dp=dp, seq_shards=seq_shards, ctx=ctx,
         )
         attn = _attn_out(lw["attn"], attn.reshape(1, t, -1), ctx)
         x = x + attn.astype(x.dtype)

@@ -97,7 +97,7 @@ def write_spec_kv(cache_layer, kv, pages, offsets):
 def paged_attention_packed_ctx(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
     scale=None, logits_soft_cap=None, mesh=None, dp: int = 1,
-    seq_shards: int = 1,
+    seq_shards: int = 1, ctx=None,
 ):
     """Packed-prefill attention where each pack segment ALSO attends to its
     sequence's cached KV pages (positions below its start offset) — the
@@ -113,10 +113,14 @@ def paged_attention_packed_ctx(
 
     One softmax spans [cached context | in-pack causal segment], keys in
     position order, so a suffix prefill over cached context is numerically
-    the same reduction as the cold full-prompt prefill.  Dense fallback body
-    (gathers all P pages per segment, O(T * P * bs) logits) — ground truth
-    for a future chunked-prefill Pallas kernel; the packed no-context fast
-    path stays on ``flash_attention``.
+    the same reduction as the cold full-prompt prefill.  Dispatches to the
+    flash-style Pallas kernel (ops/pallas/ctx_attention.py) on TPU —
+    per-segment page routing + length-bounded DMA, one online-softmax
+    reduction over [ctx | pack]; the jnp dense body (gathers all P pages
+    per segment, O(T * P * bs) logits) stays the fallback + ground truth,
+    and ``ctx.fused is False`` (ops.quantizer.ServingContext) pins the jnp
+    body per engine — the kernel-vs-dense A/B lever.  The packed
+    no-context fast path stays on ``flash_attention``.
 
     With ``mesh`` the call runs under ``shard_map`` exactly like
     :func:`paged_attention_decode`: q split on heads over ``model``, the
@@ -135,12 +139,42 @@ def paged_attention_packed_ctx(
     only so the log-sum-exp ring merge counts them exactly once — and the
     ``S`` partials combine with the same ``S-1``-hop ring pass as decode.
     """
+    fused = getattr(ctx, "fused", None) if ctx is not None else None
     if mesh is not None and (_model_axis_size(mesh) > 1 or dp > 1
                              or seq_shards > 1):
         return _paged_attention_packed_ctx_tp(
             q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
             ctx_lens, mesh, dp=dp, seq_shards=seq_shards, scale=scale,
-            logits_soft_cap=logits_soft_cap,
+            logits_soft_cap=logits_soft_cap, fused=fused,
+        )
+    return _paged_attention_packed_ctx_local(
+        q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+        ctx_lens, scale=scale, logits_soft_cap=logits_soft_cap, fused=fused,
+    )
+
+
+def _use_ctx_kernel(fused, q, cache_k_layer, ctx_tables):
+    """Kernel-vs-fallback gate for the packed-ctx path, same convention as
+    the decode/flash kernels: on TPU (or under ``set_interpret``) and the
+    shape is supported; ``fused=False`` (the ServingContext A/B lever) pins
+    the jnp body."""
+    from ..ops.pallas import on_tpu
+    from ..ops.pallas import ctx_attention as ck
+
+    return (fused is not False and (on_tpu() or ck._INTERPRET)
+            and ck.supports(q, cache_k_layer, ctx_tables))
+
+
+def _paged_attention_packed_ctx_local(
+    q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
+    scale=None, logits_soft_cap=None, fused=None,
+):
+    if _use_ctx_kernel(fused, q, cache_k_layer, ctx_tables):
+        from ..ops.pallas import ctx_attention as ck
+
+        return ck.paged_attention_packed_ctx_kernel(
+            q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+            ctx_lens, scale=scale, logits_soft_cap=logits_soft_cap,
         )
     return _paged_attention_packed_ctx_dense(
         q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
@@ -148,9 +182,27 @@ def paged_attention_packed_ctx(
     )
 
 
+def _packed_ctx_partial_local(
+    q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
+    include_pack, scale=None, logits_soft_cap=None, fused=None,
+):
+    if _use_ctx_kernel(fused, q, cache_k_layer, ctx_tables):
+        from ..ops.pallas import ctx_attention as ck
+
+        return ck.paged_attention_packed_ctx_kernel(
+            q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+            ctx_lens, scale=scale, logits_soft_cap=logits_soft_cap,
+            include_pack=include_pack, partial=True,
+        )
+    return _packed_ctx_partial(
+        q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables,
+        ctx_lens, include_pack, scale=scale, logits_soft_cap=logits_soft_cap,
+    )
+
+
 def _paged_attention_packed_ctx_tp(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
-    mesh, dp=1, seq_shards=1, scale=None, logits_soft_cap=None,
+    mesh, dp=1, seq_shards=1, scale=None, logits_soft_cap=None, fused=None,
 ):
     """Manual-region packed-ctx attention on the (batch, seq, model) serve
     mesh.
@@ -208,8 +260,8 @@ def _paged_attention_packed_ctx_tp(
     pk_spec = P(batch_axis, kv_head_axis, None)
     pool_spec = P(block_axis, None, kv_head_axis, None)
     local = functools.partial(
-        _paged_attention_packed_ctx_dense, scale=scale,
-        logits_soft_cap=logits_soft_cap,
+        _paged_attention_packed_ctx_local, scale=scale,
+        logits_soft_cap=logits_soft_cap, fused=fused,
     )
     rows_per = n // dp
 
@@ -252,9 +304,9 @@ def _paged_attention_packed_ctx_tp(
         if S == 1:
             return local(q_l, k_l, v_l, seg, ck, cv, bt, sl)
         include_pack = jax.lax.axis_index(SEQ_AXIS) == 0
-        acc, m, l = _packed_ctx_partial(
+        acc, m, l = _packed_ctx_partial_local(
             q_l, k_l, v_l, seg, ck, cv, bt, sl, include_pack,
-            scale=scale, logits_soft_cap=logits_soft_cap)
+            scale=scale, logits_soft_cap=logits_soft_cap, fused=fused)
         mine = jnp.concatenate([acc, m[..., None], l[..., None]], axis=-1)
         c = mine
         # unrolled S-1 collective-permute hops, same carry as decode
@@ -277,11 +329,18 @@ def _paged_attention_packed_ctx_dense(
     q, k, v, segment_ids, cache_k_layer, cache_v_layer, ctx_tables, ctx_lens,
     scale=None, logits_soft_cap=None,
 ):
-    """jnp reference body (single-shard): gathers all P pages per segment,
-    O(T * P * bs) logits."""
+    """jnp reference body (single-shard): gathers up to P pages per segment,
+    O(T * P * bs) logits.  When ``ctx_lens`` is concrete (eager / parity
+    tests) the gathered page range clamps to ``ceil(max(ctx_lens)/bs)`` so
+    the ground-truth path also scales with TRUE cached context rather than
+    table capacity; under jit the lens are traced and P stays static."""
     t, hq, hd = q.shape
     nb, bs, hkv, _ = cache_k_layer.shape
     n, p = ctx_tables.shape
+    if p > 1 and not isinstance(ctx_lens, jax.core.Tracer):
+        p_live = int(-(-int(jnp.max(ctx_lens)) // bs))
+        p = max(min(p, p_live), 1)
+        ctx_tables = ctx_tables[:, :p]
     rep = hq // hkv
     scale = scale if scale is not None else float(hd) ** -0.5
     seg_row = jnp.clip(segment_ids - 1, 0, n - 1)  # [T] pack row per token

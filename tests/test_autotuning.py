@@ -142,6 +142,25 @@ def test_serve_cost_model_orders_formats():
         < cost({"tp": 2, "quant_comm": "none"})
 
 
+def test_serve_cost_model_charges_ctx_attention_kv_traffic():
+    from deepspeed_tpu.models import get_preset
+
+    cfg = get_preset("tiny")
+    big = {"max_seqs": 8, "num_blocks": 256, "block_size": 16}
+    small = {"max_seqs": 8, "num_blocks": 32, "block_size": 16}
+    costb = lambda c: roofline.predict_serve_cost(c, cfg, big)
+    costs = lambda c: roofline.predict_serve_cost(c, cfg, small)
+    # chunked prefill streams cached context pages through the packed-ctx
+    # attention on top of the decode read — not free anymore
+    assert costb({"prefill_chunk": 32}) > costb({})
+    # spec verify re-reads the context KV, so its amortization margin
+    # narrows as the pool (live context) grows...
+    spec = {"spec": True, "spec_max_draft": 4}
+    assert costb(spec) / costb({}) > costs(spec) / costs({})
+    # ...but the per-token amortization still wins at these pool sizes
+    assert costb(spec) < costb({})
+
+
 def test_train_cost_model_prefers_bigger_micro_and_charges_remat():
     from deepspeed_tpu.models import get_preset
 
