@@ -15,6 +15,7 @@ does not.
 from __future__ import annotations
 
 import json
+import math
 import time
 
 import jax
@@ -791,6 +792,106 @@ def router_serve_main(smoke=False, chaos=False):
         1 - handoff["int8"]["wire_bytes"]
         / max(handoff["none"]["wire_bytes"], 1), 3)
 
+    # --- fleet observability: merged histograms + stitched trace -----------
+    # Telemetry-ON router (3 workers, one prefill-role so a handoff lands
+    # on the trace) with a fleet collector attached: the percentile table
+    # comes from MERGED per-worker histogram states and is cross-checked
+    # against the pooled raw samples; the stitched chrome trace must show
+    # every worker's request namespace plus the router's route/handoff
+    # spans for the migrated request.
+    from deepspeed_tpu.telemetry import (Telemetry, attach_fleet_collector,
+                                         fleet_chrome_trace,
+                                         format_percentile_table)
+    ftel = Telemetry(True)
+    rf = build_router(
+        params, cfg, sec,
+        router=dict(n_workers=3, prefill_workers=1,
+                    disagg_threshold=min(long_len, sys_len + sfx_len),
+                    metrics_pull_interval_ms=25.0),
+        telemetry=ftel)
+    collector = attach_fleet_collector(rf, start=False)
+    for u in prompts:
+        assert rf.try_submit(u, prompts[u], samp).accepted
+    rf.submit(9001, long_prompt, samp)
+    collector.pull_once()
+    fleet_out = rf.run()
+    collector.pull_once()
+    fleet = collector.fleet
+    fleet_table = fleet.merged_summary()
+    print(format_percentile_table(
+        fleet_table, title="fleet latency percentiles (merged across "
+        f"{len(fleet.workers())} workers)"))
+    assert fleet_table.get("ttft_ms", {}).get("count", 0) > 0, fleet_table
+    # merged quantiles vs pooled per-worker ground truth: exact while every
+    # shard kept raw samples (the smoke sizes stay under the cap), within
+    # the documented sqrt(growth) relative bound once bucketed
+    for metric in ("ttft_ms", "e2e_ms"):
+        pooled = []
+        for st in fleet.histogram_states(metric):
+            pooled.extend(st["samples"] or [])
+        merged = fleet.merged_histogram(metric)
+        if merged is None or not pooled:
+            continue
+        for q in (50, 90, 99):
+            rank = min(len(pooled), max(1, math.ceil(q / 100 * len(pooled))))
+            truth = sorted(pooled)[rank - 1]
+            got = merged.percentile(q)
+            if merged.exact and merged.count == len(pooled):
+                assert got == truth, (metric, q, got, truth)
+            else:
+                bound = merged._growth ** 0.5 + 0.02
+                assert truth / bound <= got <= truth * bound, (
+                    metric, q, got, truth)
+    sig = rf.signals()
+    s_fleet = dict(rf.stats)
+    assert s_fleet["handoffs"] >= 1, s_fleet
+    assert sig["slo"]["availability"] == 1.0, sig["slo"]
+    assert sig["fleet_counters"], sig
+    # stitched trace: router spans (pid 0) for the migrated request +
+    # every worker's own request-namespace pid
+    trace = fleet_chrome_trace(fleet, telemetry=ftel)
+    req_pids = {e["pid"] for e in trace["traceEvents"]
+                if e.get("ph") == "X" and e["pid"] % 2 == 1}
+    router_spans = [e for e in trace["traceEvents"]
+                    if e.get("ph") == "X" and e["pid"] == 0
+                    and e.get("args", {}).get("uid") == 9001]
+    assert len(req_pids) >= 2, sorted(req_pids)
+    assert any(e["name"] == "route" for e in router_spans), router_spans
+    assert any(e["name"] == "handoff" for e in router_spans), router_spans
+    fleet_identical = None
+    if check_identity:
+        assert all(fleet_out[u] == ("finished", want[u][1])
+                   for u in prompts), "telemetry-on routed tokens diverged"
+        # telemetry-off twin of the SAME config: tokens AND router stats
+        # must be identical — observability must not change behavior
+        rt = build_router(
+            params, cfg, sec,
+            router=dict(n_workers=3, prefill_workers=1,
+                        disagg_threshold=min(long_len, sys_len + sfx_len)))
+        for u in prompts:
+            assert rt.try_submit(u, prompts[u], samp).accepted
+        rt.submit(9001, long_prompt, samp)
+        twin_out = rt.run()
+        fleet_identical = (twin_out == fleet_out
+                           and dict(rt.stats) == s_fleet)
+        assert twin_out == fleet_out, "telemetry flipped routed tokens"
+        assert dict(rt.stats) == s_fleet, (dict(rt.stats), s_fleet)
+        at = rt.close()
+        assert all(a["blocks_in_use"] == 0 for a in at), at
+    fleet_extra = {
+        "workers": len(fleet.workers()),
+        "merged_ttft_p50_ms": round(
+            fleet_table.get("ttft_ms", {}).get("p50", 0.0), 3),
+        "merged_quantiles_match_pooled_samples": True,
+        "slo_availability": sig["slo"]["availability"],
+        "trace_request_pid_namespaces": len(req_pids),
+        "telemetry_off_twin_identical": fleet_identical,
+        "pull_failures": sum(s["failures"]
+                             for s in sig["fleet"].values()),
+    }
+    af = rf.close()
+    assert all(a["blocks_in_use"] == 0 for a in af), af
+
     # --- chaos: fault storm + worker kill vs single-engine baseline --------
     chaos_extra = None
     if chaos:
@@ -824,6 +925,7 @@ def router_serve_main(smoke=False, chaos=False):
         r3 = build_router(params, cfg, sec, router=dict(n_workers=2),
                           serve=serve_kw, faults=kill_inj,
                           engine_faults=storm_injector())
+        c3 = attach_fleet_collector(r3, start=False)
         backlog = []
         for u in prompts:
             res = r3.try_submit(u, prompts[u], samp)
@@ -841,6 +943,17 @@ def router_serve_main(smoke=False, chaos=False):
                 raise RuntimeError("router chaos loop did not converge")
         storm_out = {u: r3.pop_result(u) for u in prompts}
         storm_avail = availability(storm_out)
+        # SLO monitor vs the bench's own availability over ALL requests
+        # (the SLO view counts injected victims too; ``availability()``
+        # above is healthy-only, so recompute from terminal states)
+        c3.pull_once()
+        slo3 = r3.signals()["slo"]
+        term = [storm_out[u][0] for u in prompts]
+        n_fin = sum(s == "finished" for s in term)
+        n_err = sum(s in ("failed", "timed_out") for s in term)
+        assert abs(slo3["availability"]
+                   - n_fin / max(n_fin + n_err, 1)) < 1e-12, (slo3, term)
+        assert slo3["finished"] == n_fin and slo3["errors"] == n_err, slo3
         s3 = dict(r3.stats)
         a3 = r3.close()
         assert all(a["blocks_in_use"] == 0 for a in a3), a3
@@ -854,6 +967,8 @@ def router_serve_main(smoke=False, chaos=False):
             assert replay_identical, "replayed tokens diverged"
         chaos_extra = {
             "availability": round(storm_avail, 4),
+            "slo_monitor_availability": round(slo3["availability"], 4),
+            "slo_fast_burn_rate": round(slo3["fast_burn_rate"], 2),
             "single_engine_baseline_availability": round(base_avail, 4),
             "worker_deaths": s3["worker_deaths"],
             "replays": s3["replays"],
@@ -895,6 +1010,7 @@ def router_serve_main(smoke=False, chaos=False):
             "worker_namespaces": namespaces,
             "allocator_leak_check": "pass",
             "kv_handoff": handoff,
+            "fleet": fleet_extra,
             "chaos": chaos_extra,
         },
     }))

@@ -78,8 +78,9 @@ HOT_PATHS: Dict[str, Set[str]] = {
     # instrumentation must never add a device round trip to a worker's tick
     # (each engine already owns its one designed np.asarray fetch), and the
     # KV-handoff codec runs host-side numpy by design
-    "serving/router.py": {"tick", "try_submit", "_route", "_candidates",
-                          "_maybe_migrate", "_kill_worker", "_finish"},
+    "serving/router.py": {"tick", "try_submit", "_route", "_route_to_worker",
+                          "_candidates", "_maybe_migrate", "_kill_worker",
+                          "_finish"},
     "serving/handoff.py": {"extract_request", "inject_request"},
     "serving/pool.py": {"load", "queue_depth", "running", "headroom_blocks",
                         "shedding"},
@@ -108,6 +109,11 @@ HOT_PATHS: Dict[str, Set[str]] = {
     "inference/ragged.py": {"allocate", "can_allocate", "_evict_one",
                             "_push_free", "stripe_of", "free", "invalidate",
                             "ensure_capacity", "ensure_writable"},
+    # the fleet collector's pull loop (ISSUE 20): it runs beside the router
+    # thread and must stay pure host bookkeeping — a device sync inside a
+    # pull would be charged to whichever worker the collector happened to
+    # be reading, and the fold must never touch anything but its own lock
+    "telemetry/fleet.py": {"pull_once", "_run", "ingest"},
 }
 
 # grandfathered `global` rebinds: (file, name).  Shrink-only.
@@ -160,6 +166,14 @@ _HOST_SYNC_FUNCS = {"device_get"}
 # either one imported from a HOT_PATHS module is a layering inversion
 _CONTROLLER_MODULE = "autotuning.controller"
 _CONTROLLER_NAMES = {"OnlineController", "attach_controller"}
+
+# the fleet observability plane gets the same layering rule: it OBSERVES
+# the data plane (its collector thread pulls workers over sockets), so no
+# tick-path module may import it — attachment is duck-typed
+# (Router.attach_fleet), wired by the launcher/bench
+_FLEET_MODULE = "telemetry.fleet"
+_FLEET_NAMES = {"FleetRegistry", "FleetCollector", "SloMonitor",
+                "attach_fleet_collector", "fleet_chrome_trace"}
 
 
 @dataclass(frozen=True)
@@ -239,11 +253,24 @@ class _Visitor(ast.NodeVisitor):
             "the reverse",
         )
 
+    def _fleet_import(self, node: ast.AST, what: str) -> None:
+        self._emit(
+            "fleet-import", node,
+            f"tick-path module imports the fleet observability plane "
+            f"({what}) — the collector thread does socket I/O and is "
+            "excluded from HOT_PATHS precisely because nothing on the "
+            "tick path may call it; attachment is duck-typed "
+            "(Router.attach_fleet), wired by the launcher/bench",
+        )
+
     def visit_Import(self, node: ast.Import) -> None:
         if self.hot_names is not None:
             for alias in node.names:
                 if _CONTROLLER_MODULE in alias.name:
                     self._controller_import(node, alias.name)
+                if _FLEET_MODULE in alias.name \
+                        and self.relpath != "telemetry/fleet.py":
+                    self._fleet_import(node, alias.name)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -257,6 +284,15 @@ class _Visitor(ast.NodeVisitor):
                         if a.name in _CONTROLLER_NAMES or a.name == "controller"]
                 if hits:
                     self._controller_import(node, f"{mod}.{hits[0]}")
+            if self.relpath != "telemetry/fleet.py":
+                if _FLEET_MODULE in mod:
+                    self._fleet_import(node, mod)
+                elif mod == "telemetry" or mod.endswith(".telemetry") \
+                        or (node.level > 0 and mod == "telemetry"):
+                    hits = [a.name for a in node.names
+                            if a.name in _FLEET_NAMES or a.name == "fleet"]
+                    if hits:
+                        self._fleet_import(node, f"{mod}.{hits[0]}")
         self.generic_visit(node)
 
     # -- rule: host sync in hot paths --------------------------------------

@@ -1420,6 +1420,120 @@ def scenario_retune_vs_tick(seed: int, n_requests: int = 4) -> None:
             f"leak: {alloc.total_blocks - alloc.available_blocks} blocks")
 
 
+def scenario_metrics_pull_vs_death(seed: int, n_requests: int = 4) -> None:
+    """A fleet collector pull races routing, the router tick loop and a
+    worker kill.  Invariants: a pull NEVER observes a torn histogram
+    state (total count equals the bucket total; the exact-sample list,
+    while present, matches the count) or a torn counter table; a pull
+    landing on a dead worker degrades to a counted failure, never an
+    exception; merged fleet rollups stay well-formed at every
+    interleaving; the ticker's request-conservation invariant holds at
+    every point (the collector cannot block or break a tick); zero
+    blocks leak."""
+    from ..inference import scheduler as sched_mod
+    from ..inference.sampling import SamplingParams
+    from ..serving.pool import Worker
+    from ..serving.router import Router
+    from ..telemetry import FleetCollector, FleetRegistry, Telemetry
+
+    sched = Schedule(seed, max_preemptions=32)
+    with sched.instrument():
+        tel = Telemetry(True)
+        workers = []
+        for i in range(2):
+            eng, _ss = _stub_scheduler(telemetry=tel)
+            workers.append(Worker(i, eng))
+
+        class _StubPool:
+            def __init__(self, ws, telemetry):
+                self.workers = ws
+                self.telemetry = telemetry
+
+            @property
+            def alive(self):
+                return [w for w in self.workers if w.alive]
+
+            @property
+            def decode_workers(self):
+                return self.alive
+
+            prefill_workers: List[Any] = []
+
+            def prefix_hit_rate(self):
+                return 0.0
+
+            def close(self):
+                return [w.close() if w.alive else (w.close_audit or {})
+                        for w in self.workers]
+
+        pool = _StubPool(workers, tel)
+        router = Router(pool)
+        fleet = FleetRegistry()
+        collector = FleetCollector(
+            fleet, lambda: [(f"worker{w.index}", w) for w in pool.alive],
+            spans=True)
+        submitted: List[int] = []
+
+        def submitter() -> None:
+            for i in range(n_requests):
+                res = router.try_submit(
+                    500 + i, [1, 2, 3, 4],
+                    SamplingParams(temperature=0.0, max_new_tokens=2))
+                if res.accepted:
+                    submitted.append(500 + i)
+
+        def ticker() -> None:
+            for _ in range(8):
+                router.tick()
+                for uid in submitted:  # conservation: tracked or terminal
+                    assert (uid in router._reqs) != (uid in router._results), uid
+
+        def killer() -> None:
+            checkpoint()
+            if workers[1].alive:
+                router._kill_worker(workers[1])
+
+        def puller() -> None:
+            # the collector thread's loop body, interleaved against
+            # everything else; each pull validates what it just folded
+            for _ in range(4):
+                collector.pull_once()
+                checkpoint()
+                snap = fleet.snapshot()
+                for name, slot in snap.items():
+                    assert slot["pulls"] + slot["failures"] >= 1, (name, slot)
+                for states in (fleet.histogram_states("ttft_ms")
+                               + fleet.histogram_states("e2e_ms")):
+                    assert states["count"] == sum(states["counts"]), states
+                    if states["samples"] is not None:
+                        assert len(states["samples"]) == states["count"], states
+                merged = fleet.merged_histogram("ttft_ms")
+                if merged is not None:
+                    assert merged.count == sum(merged._counts)
+                assert fleet.merge_conflicts == 0
+                # signals() is the cross-thread read surface: it must be
+                # callable mid-anything and internally consistent
+                sig = router.signals()
+                assert sig["workers_alive"] == len(pool.alive)
+
+        sched.spawn(submitter, name="submit")
+        sched.spawn(ticker, name="tick")
+        sched.spawn(killer, name="kill")
+        sched.spawn(puller, name="pull")
+        sched.run()
+
+        # a pull against the killed worker must have degraded, not raised
+        collector.pull_once()
+        assert [w for w in pool.alive] or fleet.snapshot()
+        results = router.run(wait_for=submitted, max_ticks=256)
+        for uid in submitted:
+            state, _toks = results[uid]
+            assert state in (sched_mod.FINISHED, sched_mod.FAILED,
+                             sched_mod.TIMED_OUT), (uid, state)
+        audits = router.close()
+        assert all(a.get("blocks_in_use", 0) == 0 for a in audits), audits
+
+
 SCENARIOS = (
     scenario_namespace_claims,
     scenario_submit_tick_cancel,
@@ -1429,6 +1543,7 @@ SCENARIOS = (
     scenario_heartbeat_expiry_vs_route,
     scenario_cancel_during_megastep,
     scenario_retune_vs_tick,
+    scenario_metrics_pull_vs_death,
 )
 
 

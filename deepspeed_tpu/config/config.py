@@ -495,6 +495,22 @@ class RouterConfig:
     # decode_megastep x worker tick duration).  Exactly-once replay is
     # unchanged: the whole burst is one rid in the reply cache.
     decode_megastep: int = 1
+    # fleet observability (telemetry/fleet.py): a router-side collector
+    # thread pulls each worker's mergeable registry snapshot over its own
+    # metrics channel every ``metrics_pull_interval_ms`` and folds it into
+    # the FleetRegistry/SloMonitor published through ``Router.signals()``.
+    # Off by default — disabled is byte-identical to no collector (nothing
+    # dials, nothing pulls).  ``slo_objective`` is the availability target
+    # the burn rates are computed against (error budget = 1 - objective);
+    # ``slo_fast_window_s``/``slo_slow_window_s`` are the two burn-rate
+    # windows (fast catches a cliff, slow catches a smoulder).
+    # ``pull_spans``: also drain worker span events each pull so
+    # ``fleet_chrome_trace`` can stitch one cross-process timeline.
+    metrics_pull_interval_ms: Optional[float] = None
+    pull_spans: bool = True
+    slo_objective: float = 0.999
+    slo_fast_window_s: float = 5.0
+    slo_slow_window_s: float = 60.0
 
     def __post_init__(self):
         if self.n_workers < 1:
@@ -558,6 +574,22 @@ class RouterConfig:
             raise ConfigError(
                 f"router.decode_megastep must be >= 1, got "
                 f"{self.decode_megastep}")
+        if (self.metrics_pull_interval_ms is not None
+                and self.metrics_pull_interval_ms <= 0):
+            raise ConfigError(
+                f"router.metrics_pull_interval_ms must be > 0 or None, got "
+                f"{self.metrics_pull_interval_ms}")
+        if not 0.0 < self.slo_objective < 1.0:
+            raise ConfigError(
+                f"router.slo_objective must be in (0, 1), got "
+                f"{self.slo_objective}")
+        if self.slo_fast_window_s <= 0 or self.slo_slow_window_s <= 0:
+            raise ConfigError(
+                "router.slo_fast_window_s and slo_slow_window_s must be > 0")
+        if self.slo_slow_window_s < self.slo_fast_window_s:
+            raise ConfigError(
+                f"router.slo_slow_window_s ({self.slo_slow_window_s}) must "
+                f"be >= slo_fast_window_s ({self.slo_fast_window_s})")
 
 
 @dataclass

@@ -180,6 +180,34 @@ class Worker:
         per-worker knob push."""
         return self.scheduler.apply_knobs(**knobs)
 
+    def export_metrics(self, spans: bool = False) -> Optional[Dict[str, Any]]:
+        """Mergeable snapshot of THIS worker's slice of the shared registry
+        (the same facade ``RemoteWorker.export_metrics`` serves over the
+        ``metrics_pull`` wire op).  In-process pools share ONE ``Telemetry``,
+        so the snapshot filters by the engine's claimed namespaces
+        (``serve``/``sched``/``comm`` families) — per-worker views never
+        alias.  ``spans`` is accepted for facade parity but ignored here:
+        the shared recorder already holds every in-process span, so the
+        fleet trace uses the local telemetry directly instead of draining
+        (a per-worker drain of the SHARED recorder would steal siblings'
+        events).  Thread-safe (registry state is lock-guarded), so the
+        collector thread may call this without marshalling to the tick
+        thread.  Returns None once the worker is dead."""
+        if not self.alive:
+            return None
+        eng = self.engine
+        prefixes = tuple(
+            p for p in (getattr(eng, "_ns", None),
+                        getattr(eng, "_sched_ns", None),
+                        getattr(eng, "_comm_ns", None))
+            if p)
+        tel = eng.telemetry
+        return {
+            "metrics": tel.registry.export_state(prefixes or None),
+            "ts": tel.clock(),
+            "events": [],
+        }
+
     # -- the KV-handoff surface ----------------------------------------------
     def extract_handoff(self, uid: int, fmt: str) -> handoff_mod.KVHandoff:
         return handoff_mod.extract_request(self.engine, uid, fmt=fmt)

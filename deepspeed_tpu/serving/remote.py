@@ -46,6 +46,8 @@ from .transport import (
     ChaosLink,
     HEARTBEAT_CHANNEL,
     HeartbeatMonitor,
+    METRICS_CHANNEL,
+    MetricsChannel,
     ProtocolError,
     RPC_CHANNEL,
     RpcClient,
@@ -289,6 +291,10 @@ class RemoteWorker:
         self._hb_chaos = ChaosLink(hb_faults, endpoint=index,
                                    partition_cell=self.chaos._partition)
         self._transport_dead = False
+        # lazy third channel for the fleet collector THREAD (rpc = router
+        # thread, hb = monitor thread): dialed on the first export_metrics
+        # call so routers without a collector never pay the connection
+        self._metrics_chan: Optional[MetricsChannel] = None
         self._load: Dict[str, Any] = {}
         self._views: Dict[int, _ReqView] = {}
         self._tick_rid: Optional[int] = None
@@ -325,6 +331,17 @@ class RemoteWorker:
             self.host, self.port, HEARTBEAT_CHANNEL,
             connect_timeout=timeout_ms / 1e3,
             max_frame_bytes=cfg.max_frame_bytes, chaos=self._hb_chaos)
+        return stream
+
+    def _dial_metrics(self):
+        cfg = self.config
+        # no chaos injector: the seeded links are per-thread (rpc/hb), and
+        # a dropped pull already degrades to None — chaos coverage of the
+        # collector rides the partition windows severing the whole address
+        stream, _ = transport.dial(
+            self.host, self.port, METRICS_CHANNEL,
+            connect_timeout=cfg.connect_timeout_ms / 1e3,
+            max_frame_bytes=cfg.max_frame_bytes)
         return stream
 
     # -- liveness ------------------------------------------------------------
@@ -495,6 +512,25 @@ class RemoteWorker:
                                 f"worker unreachable: {e}",
                                 retry_after_ms=self.config.retry_backoff_ms)
 
+    def export_metrics(self, spans: bool = False) -> Optional[Dict[str, Any]]:
+        """Mergeable registry snapshot pulled over the dedicated metrics
+        channel (same facade as the in-process ``pool.Worker``).  Called
+        from the fleet collector thread ONLY — the channel is single-owner
+        like rpc/heartbeat.  Degrades to None when the worker is dead or
+        the pull fails (death discovery belongs to the heartbeat lease,
+        not the collector)."""
+        if not self.alive or self._transport_dead:
+            return None
+        if self._metrics_chan is None:
+            self._metrics_chan = MetricsChannel(self._dial_metrics)
+        reply = self._metrics_chan.pull(
+            spans=spans, timeout=self.config.rpc_deadline_ms / 1e3)
+        if reply is None:
+            return None
+        return {"metrics": reply.get("metrics") or {},
+                "ts": reply.get("ts"),
+                "events": reply.get("events") or []}
+
     def stats(self) -> Dict[str, Any]:
         try:
             reply = self._call({"op": "stats"})
@@ -577,6 +613,9 @@ class RemoteWorker:
         self.alive = False
         self.monitor.unwatch(self.index)
         self.client.close()
+        chan, self._metrics_chan = self._metrics_chan, None
+        if chan is not None:
+            chan.close()
         if self.handle is not None:
             self.handle.reap()
 

@@ -63,7 +63,7 @@ from ..inference.scheduler import (
     RETRY_LATER,
     SubmitResult,
 )
-from ..telemetry import StatsView
+from ..telemetry import RateView, StatsView
 from .pool import MIXED_ROLE, WorkerPool
 from .transport import WorkerDead
 
@@ -87,6 +87,9 @@ class RouterRequest:
     routed_by: str = ""  # affinity | least_loaded | prefill
     replays: int = 0
     chain_keys: List[object] = field(default_factory=list)
+    # open "queued" recorder span while the request sits in the router
+    # backlog (None otherwise) — ended when it routes, expires or fails
+    queue_span: Any = None
 
 
 class Router:
@@ -136,6 +139,18 @@ class Router:
         self._affinity: "OrderedDict[object, int]" = OrderedDict()
         self.tick_no = 0
         self._closed = False
+        # windowed first derivatives over the router's health counters —
+        # the drift signals ``signals()`` publishes (RateView is internally
+        # locked, so a controller thread may sample them freely)
+        self._rates = {k: RateView(self._c[k]) for k in (
+            "discovered_deaths", "replays", "shed_rejections",
+            "no_worker_refusals")}
+        # the attached fleet observability plane (telemetry/fleet.py) —
+        # None until ``attach_fleet_collector`` wires one on.  The router
+        # never imports the fleet module (same layering as the adaptation
+        # controller: astlint's fleet-import rule); it consumes the
+        # attached collector by duck type in signals()/close().
+        self._fleet_collector = None
 
     # -- affinity map --------------------------------------------------------
     def _chain_keys(self, tokens: Sequence[int]) -> List[object]:
@@ -214,6 +229,18 @@ class Router:
         return max(rec.deadline_ms - elapsed, 0.001)
 
     def _route(self, rec: RouterRequest) -> SubmitResult:
+        """One routing attempt, stamped as a ``route`` span on the shared
+        recorder's ``router`` track (uid-tagged, so the stitched fleet
+        trace shows where each placement decision sits on the timeline).
+        Placement itself is :meth:`_route_to_worker`."""
+        sp = self.telemetry.recorder.start(
+            "route", track="router", uid=rec.uid, replays=rec.replays)
+        res = self._route_to_worker(rec)
+        sp.end(accepted=res.accepted, worker=rec.worker,
+               kind=rec.routed_by or res.reason)
+        return res
+
+    def _route_to_worker(self, rec: RouterRequest) -> SubmitResult:
         """Place ``rec`` on a worker.  CLIENT_ERRORS propagate (every worker
         shares one engine config, so an invalid request is invalid
         everywhere) — EXCEPT sampling conflicts, which are per-worker BATCH
@@ -309,6 +336,8 @@ class Router:
         if not res.accepted:  # every worker shedding: queue at the router
             rec.phase = BACKLOG
             self._backlog.append(uid)
+            rec.queue_span = self.telemetry.recorder.start(
+                "queued", track="router", uid=uid)
         return SubmitResult(uid, QUEUED)
 
     def submit(self, uid: int, tokens: Sequence[int],
@@ -342,6 +371,9 @@ class Router:
     # -- terminal bookkeeping ------------------------------------------------
     def _finish(self, rec: RouterRequest, state: str, tokens: List[int],
                 error: Optional[str]) -> None:
+        if rec.queue_span is not None:
+            rec.queue_span.end(outcome=state)
+            rec.queue_span = None
         self._results[rec.uid] = (state, tokens, error)
         rec.phase = DONE
         self._reqs.pop(rec.uid, None)
@@ -407,8 +439,14 @@ class Router:
             return
         rec.replays += 1
         self._c["replays"].inc()
+        self.telemetry.recorder.start(
+            "replay", track="router", uid=rec.uid,
+            attempt=rec.replays).end()
         rec.phase = BACKLOG
         self._backlog.append(rec.uid)
+        if rec.queue_span is None:
+            rec.queue_span = self.telemetry.recorder.start(
+                "queued", track="router", uid=rec.uid)
 
     # -- prefill/decode migration -------------------------------------------
     def _maybe_migrate(self, rec: RouterRequest) -> None:
@@ -422,8 +460,12 @@ class Router:
         targets = [w for w in self.pool.decode_workers
                    if not w.shedding and w is not src]
         ho = None
+        sp = None
         for tgt in sorted(targets, key=self._cost):
             if ho is None:
+                sp = self.telemetry.recorder.start(
+                    "handoff", track="router", uid=rec.uid, src=src.index,
+                    fmt=self.config.handoff_fmt)
                 try:
                     ho = src.extract_handoff(rec.uid,
                                              fmt=self.config.handoff_fmt)
@@ -433,6 +475,7 @@ class Router:
                     # death path
                     rec.disagg = False
                     self._c["handoff_fallbacks"].inc()
+                    sp.end(outcome="extract_failed")
                     return
             res = tgt.adopt_handoff(
                 ho, sampling=rec.sampling,
@@ -447,11 +490,14 @@ class Router:
                     tgt.cancel(rec.uid)
                     tgt.pop_result(rec.uid)
                     rec.disagg = False
+                    sp.end(outcome="cancelled")
                     return
                 rec.worker = tgt.index
                 rec.disagg = False
                 self._c["handoffs"].inc()
                 self._c["handoff_wire_bytes"].inc(ho.wire_bytes)
+                sp.end(outcome="migrated", tgt=tgt.index,
+                       wire_bytes=ho.wire_bytes)
                 if rec.chain_keys and ho.fmt == "none":
                     # only the exact wire publishes the migrated prefix on
                     # the target (lossy pages stay unkeyed) — re-pointing
@@ -465,6 +511,8 @@ class Router:
         # not disaggregated) and stop retrying
         rec.disagg = False
         self._c["handoff_fallbacks"].inc()
+        if sp is not None:
+            sp.end(outcome="fallback")
 
     # -- the loop ------------------------------------------------------------
     def tick(self) -> None:
@@ -539,6 +587,9 @@ class Router:
             res = self._route(rec)
             if res.accepted:
                 self._backlog.remove(uid)
+                if rec.queue_span is not None:
+                    rec.queue_span.end(outcome="routed")
+                    rec.queue_span = None
             elif res.reason in CLIENT_ERRORS:
                 # genuinely invalid against the shared worker config (e.g.
                 # a replay hitting a pool-impossible condition): terminal
@@ -580,6 +631,59 @@ class Router:
                 out[w.index] = f"{type(e).__name__}: {e}"
         return out
 
+    # -- observability seam --------------------------------------------------
+    def attach_fleet(self, collector) -> None:
+        """Adopt a fleet collector (``telemetry.fleet.FleetCollector``,
+        duck-typed — use ``attach_fleet_collector`` to build one from this
+        router).  ``signals()`` starts publishing its registry/SLO views
+        and ``close()`` stops its thread.  Attaching replaces (and stops)
+        any previous collector."""
+        prev, self._fleet_collector = self._fleet_collector, collector
+        if prev is not None and prev is not collector:
+            prev.stop(final_pull=False)
+
+    def signals(self) -> Dict[str, Any]:
+        """Router-tier observability snapshot, mirroring
+        ``ServeScheduler.signals()`` so the adaptation controller (or an
+        elastic fleet scaler) consumes the router through the same seam it
+        uses for a single engine.  Safe from any thread: counter/RateView
+        reads are internally consistent, the worker facades are lock-free
+        host reads, and everything else is an advisory point-in-time
+        sample.  With a fleet collector attached, adds the per-worker pull
+        health, the fleet counter rollup, and the SLO monitor's
+        availability/burn-rate report."""
+        now = self._clock()
+        alive = list(self.pool.alive)
+        n = len(alive)
+        depth = self.config.shed_queue_depth
+        headrooms = [w.headroom_fraction for w in alive]
+        out: Dict[str, Any] = {
+            "tick_no": self.tick_no,
+            "workers_alive": n,
+            "backlog": len(self._backlog),
+            "inflight": len(self._reqs),
+            # fleet queue pressure: router backlog + every live worker's
+            # waiting queue (the elastic scaler's primary up signal)
+            "queue_depth": len(self._backlog) + sum(
+                w.queue_depth for w in alive),
+            "shed_pressure": (sum(1 for w in alive if w.shedding) / n
+                              if n else 1.0),
+            "shedding": depth is not None and len(self._backlog) >= depth,
+            "headroom_fraction": min(headrooms) if headrooms else 0.0,
+            "worker_backoff_s": {
+                w.index: max(w.backoff_until - now, 0.0) for w in alive},
+            "rates": {k: v.sample(now) for k, v in self._rates.items()},
+            "counters": dict(self.stats),
+        }
+        collector = self._fleet_collector
+        if collector is not None:
+            fleet = collector.fleet
+            out["fleet"] = fleet.snapshot()
+            out["fleet_counters"] = fleet.counter_rollup()
+            if collector.slo is not None:
+                out["slo"] = collector.slo.report(now, fleet=fleet)
+        return out
+
     # -- teardown ------------------------------------------------------------
     def prefix_hit_rate(self) -> float:
         return self.pool.prefix_hit_rate()
@@ -590,6 +694,11 @@ class Router:
         the per-worker zero-leak audits."""
         if self._closed:
             return [w.close_audit or {} for w in self.pool.workers]
+        # stop the fleet collector FIRST (with one final pull while the
+        # workers still answer), so teardown never races a pull
+        collector, self._fleet_collector = self._fleet_collector, None
+        if collector is not None:
+            collector.stop(final_pull=True)
         audits = self.pool.close()
         self.telemetry.release_prefix(self._ns)
         self._closed = True

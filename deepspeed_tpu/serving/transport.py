@@ -418,6 +418,7 @@ class FrameStream:
 # -- handshake ----------------------------------------------------------------
 RPC_CHANNEL = "rpc"
 HEARTBEAT_CHANNEL = "heartbeat"
+METRICS_CHANNEL = "metrics"
 
 
 def client_handshake(stream: FrameStream, channel: str,
@@ -759,7 +760,7 @@ class RpcClient:
 # -- heartbeat monitor --------------------------------------------------------
 class _HbTarget:
     __slots__ = ("stream", "redial", "last_ack", "expired", "seq", "misses",
-                 "next_redial")
+                 "next_redial", "offset_s", "offset_err_s", "rtt_s")
 
     def __init__(self, stream, now: float, redial=None):
         self.stream = stream
@@ -771,6 +772,12 @@ class _HbTarget:
         self.next_redial = 0.0  # throttle: a dead peer's redial blocks ~the
         # connect timeout, and the single monitor thread must not spend
         # every cycle inside it
+        # clock-offset estimate from PONG timestamps (None until one ack
+        # carried a remote ts); the minimum-RTT sample wins — its midpoint
+        # has the tightest error bound (<= RTT/2)
+        self.offset_s: Optional[float] = None
+        self.offset_err_s: Optional[float] = None
+        self.rtt_s: Optional[float] = None
 
 
 class HeartbeatMonitor:
@@ -831,6 +838,36 @@ class HeartbeatMonitor:
             if tgt.misses >= 2 and now - tgt.last_ack > self.lease_s:
                 tgt.expired = True
 
+    def note_clock(self, endpoint: int, t_send: float, t_recv: float,
+                   remote_ts: float) -> None:
+        """Fold one timestamped PONG into the worker's clock-offset
+        estimate: ``offset = remote_ts - (t_send + t_recv) / 2`` — the
+        remote stamped its reply somewhere inside the local round trip, so
+        the RTT midpoint is the unbiased estimate and the error is bounded
+        by RTT/2.  The minimum-RTT sample wins (tightest bound).  Pure
+        state under the lock; drivable with fake timestamps in tests."""
+        rtt = max(float(t_recv) - float(t_send), 0.0)
+        offset = float(remote_ts) - (float(t_send) + float(t_recv)) / 2.0
+        with self._lock:
+            tgt = self._targets.get(int(endpoint))
+            if tgt is None:
+                return
+            if tgt.rtt_s is None or rtt <= tgt.rtt_s:
+                tgt.rtt_s = rtt
+                tgt.offset_s = offset
+                tgt.offset_err_s = rtt / 2.0
+
+    def clock_offset(self, endpoint: int) -> Optional[Tuple[float, float]]:
+        """``(offset_s, error_bound_s)`` mapping the worker's clock onto
+        the local one (``local_ts ~= remote_ts - offset_s``), or None
+        before any timestamped ack arrived.  The fleet trace stitcher
+        shifts a worker's span timestamps by this."""
+        with self._lock:
+            tgt = self._targets.get(int(endpoint))
+            if tgt is None or tgt.offset_s is None:
+                return None
+            return (tgt.offset_s, tgt.offset_err_s)
+
     def lease_expired(self, endpoint: int) -> bool:
         now = self.clock()
         with self._lock:
@@ -847,7 +884,8 @@ class HeartbeatMonitor:
         with self._lock:
             return {
                 ep: {"age_s": now - t.last_ack, "expired": t.expired,
-                     "misses": t.misses}
+                     "misses": t.misses, "offset_s": t.offset_s,
+                     "rtt_s": t.rtt_s}
                 for ep, t in self._targets.items()
             }
 
@@ -915,35 +953,95 @@ class HeartbeatMonitor:
                     self._bump_seq(ep)
                     self.note_miss(ep)
                     continue
-                ok = self._ping(stream, seq)
+                pong = self._ping(stream, seq)
                 self._bump_seq(ep)
-                if ok:
+                if pong is not None:
                     self.note_ack(ep)
+                    if pong.get("ts") is not None:
+                        self.note_clock(ep, pong["_t_send"], pong["_t_recv"],
+                                        float(pong["ts"]))
                 else:
                     self.note_miss(ep)
 
-    def _ping(self, stream: FrameStream, seq: int) -> bool:
-        """One ping/ack exchange on the heartbeat channel.  NO locks held
-        here — socket I/O and the lease state never share a critical
-        section."""
+    def _ping(self, stream: FrameStream, seq: int) -> Optional[Dict[str, Any]]:
+        """One ping/ack exchange on the heartbeat channel.  Returns the
+        PONG payload (with local ``_t_send``/``_t_recv`` perf-clock stamps
+        bracketing the round trip, for the clock-offset estimate) or None
+        on a miss.  NO locks held here — socket I/O and the lease state
+        never share a critical section."""
         try:
+            t_send = time.perf_counter()
             stream.send_json(FT_PING, seq, {"seq": seq})
             deadline = time.monotonic() + max(self.interval_s * 2, 0.05)
             while True:
                 f = stream.recv_frame(max(deadline - time.monotonic(), 0.01))
                 if f.ftype == FT_PONG and f.rid >= seq:
+                    t_recv = time.perf_counter()
                     break
                 if time.monotonic() >= deadline:
-                    return False
+                    return None
         except TransportError:
-            return False
+            return None
         chaos = stream.chaos
         if chaos is not None and chaos.heartbeat_lost():
-            return False  # the ack was "lost on the wire"
-        return True
+            return None  # the ack was "lost on the wire"
+        try:
+            payload = f.json()
+        except ProtocolError:
+            payload = {}
+        payload["_t_send"] = t_send
+        payload["_t_recv"] = t_recv
+        return payload
 
 
 # -- worker-side server -------------------------------------------------------
+class MetricsChannel:
+    """Collector-owned pull channel to one worker — the third channel kind
+    (rpc = router thread, heartbeat = monitor thread, metrics = collector
+    thread), so a fleet poll never contends with the engine-owner RPC loop
+    and channel ownership stays one-thread-one-socket.  Failures degrade
+    to ``None`` — the heartbeat lease owns death discovery; a missed pull
+    is just a sparser sample — and the next pull redials."""
+
+    def __init__(self, dial_fn: Callable[[], FrameStream]):
+        self._dial = dial_fn
+        self._stream: Optional[FrameStream] = None
+        self._rid = 0
+
+    def pull(self, spans: bool = False,
+             timeout: float = 5.0) -> Optional[Dict[str, Any]]:
+        """One ``metrics_pull`` round trip: the worker's mergeable registry
+        state (+ drained span events when ``spans``), or None on any
+        transport failure.  Idempotent read — no retry machinery, no
+        exactly-once cache (a fresher snapshot is strictly better than a
+        replayed stale one)."""
+        self._rid += 1
+        try:
+            if self._stream is None or self._stream.closed:
+                self._stream = self._dial()
+            self._stream.send_json(FT_REQUEST, self._rid,
+                                   {"op": "metrics_pull",
+                                    "spans": bool(spans)})
+            while True:
+                f = self._stream.recv_frame(timeout=timeout)
+                if f.ftype == FT_ERROR:
+                    return None
+                if f.ftype == FT_RESPONSE and f.rid == self._rid:
+                    reply = f.json()
+                    return reply if reply.get("ok") else None
+                # stale reply from an earlier abandoned pull: skip it
+        except (TransportError, ProtocolError):
+            stream, self._stream = self._stream, None
+            if stream is not None:
+                stream.close()
+            return None
+
+    def close(self) -> None:
+        stream, self._stream = self._stream, None
+        if stream is not None:
+            stream.close()
+
+
 class WorkerServer:
     """The worker process half: serves the framed RPC protocol over a
     listening socket (``serve_socket``) or a single binary stream pair —
@@ -952,15 +1050,22 @@ class WorkerServer:
     The engine is single-owner: every op that touches it runs on the one
     RPC-serving thread.  Heartbeat channels are answered by tiny dedicated
     threads that read only ``self._load`` (a snapshot the RPC thread
-    refreshes under ``self._lock``) — never the engine.  An exactly-once
-    reply cache keyed by request id makes client retries after lost
-    responses safe for mutating ops."""
+    refreshes under ``self._lock``) — never the engine.  Metrics channels
+    likewise get their own threads reading only the lock-guarded telemetry
+    state, so a fleet pull can never block (or be blocked by) a tick.  An
+    exactly-once reply cache keyed by request id makes client retries
+    after lost responses safe for mutating ops."""
 
     def __init__(self, engine, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
                  reply_cache_size: int = 4096,
                  identity: Optional[Dict[str, Any]] = None):
         self.engine = engine
         self.scheduler = engine.scheduler
+        # stashed for the metrics-channel threads: telemetry state is
+        # internally lock-guarded (safe cross-thread), and going through
+        # this alias keeps the single-owner engine object itself out of
+        # thread-target bodies (the racelint cross-thread-engine contract)
+        self._telemetry = getattr(engine, "telemetry", None)
         self.max_frame_bytes = int(max_frame_bytes)
         self._lock = threading.Lock()
         self._load: Dict[str, Any] = {}
@@ -1078,6 +1183,10 @@ class WorkerServer:
                 threading.Thread(
                     target=self._serve_heartbeat, args=(stream,),
                     name="dstpu-worker-hb", daemon=True).start()
+            elif meta["channel"] == METRICS_CHANNEL:
+                threading.Thread(
+                    target=self._serve_metrics, args=(stream,),
+                    name="dstpu-worker-metrics", daemon=True).start()
             else:
                 self._rpc_queue.put((stream, meta))
 
@@ -1097,7 +1206,56 @@ class WorkerServer:
             try:
                 stream.send_json(FT_PONG, f.rid, {
                     "seq": f.rid, "nonce": self.identity.get("nonce"),
-                    "load": self._load_snapshot()})
+                    "load": self._load_snapshot(),
+                    # worker perf-clock reading: the monitor midpoints its
+                    # send/recv around this to estimate the clock offset
+                    # that stitches this worker's trace events onto the
+                    # router's timeline (error <= RTT/2)
+                    "ts": time.perf_counter()})
+            except TransportError:
+                break
+        stream.close()
+
+    def _serve_metrics(self, stream: FrameStream) -> None:
+        """Serve ``metrics_pull`` on a dedicated thread (one per collector
+        connection) so fleet observability never queues behind — or stalls
+        — the engine-owner RPC loop.  Touches ONLY thread-safe telemetry
+        state: ``export_state`` and the span drain take their own internal
+        locks around pure dict building (never the engine, never
+        ``self._lock``), so a pull racing a tick sees each metric's
+        consistent point-in-time state — exactly the mergeable-export
+        contract."""
+        tel = self._telemetry
+        while self._running:
+            try:
+                f = stream.recv_frame(timeout=1.0)
+            except RpcTimeout:
+                continue
+            except TransportError:
+                break
+            if f.ftype != FT_REQUEST:
+                break
+            try:
+                op = f.json()
+            except ProtocolError:
+                break
+            if op.get("op") != "metrics_pull" or tel is None:
+                try:
+                    stream.send_json(FT_ERROR, f.rid, {
+                        "kind": "bad_request",
+                        "detail": "metrics channel serves metrics_pull only"})
+                except TransportError:
+                    break
+                continue
+            out: Dict[str, Any] = {
+                "ok": True, "blobs": 0,
+                "metrics": tel.registry.export_state(),
+                "ts": time.perf_counter(),
+            }
+            if op.get("spans"):
+                out["events"] = tel.drain_chrome_events()
+            try:
+                stream.send_json(FT_RESPONSE, f.rid, out)
             except TransportError:
                 break
         stream.close()
@@ -1164,7 +1322,8 @@ class WorkerServer:
                 try:
                     stream.send_json(FT_PONG, f.rid,
                                      {"seq": f.rid,
-                                      "load": self._load_snapshot()})
+                                      "load": self._load_snapshot(),
+                                      "ts": time.perf_counter()})
                 except TransportError:
                     break
                 continue
@@ -1208,12 +1367,19 @@ class WorkerServer:
                               f"rid={bf.rid}"})
                 return False
             blobs.append(bf.payload)
-        cached = self._replies.get(f.rid)
+        # metrics_pull is EXEMPT from the exactly-once reply cache: a pull
+        # is an idempotent read (re-executing a retried pull returns a
+        # FRESHER snapshot, which is strictly better than a cached stale
+        # one), and caching would pin multi-KB registry payloads in a cache
+        # sized for control replies
+        no_cache = op.get("op") == "metrics_pull"
+        cached = None if no_cache else self._replies.get(f.rid)
         if cached is None:
             reply, rblobs = self._dispatch(op, blobs)
-            self._replies[f.rid] = (reply, rblobs)
-            while len(self._replies) > self._reply_cache_size:
-                self._replies.popitem(last=False)
+            if not no_cache:
+                self._replies[f.rid] = (reply, rblobs)
+                while len(self._replies) > self._reply_cache_size:
+                    self._replies.popitem(last=False)
         else:
             reply, rblobs = cached
         stream.send_json(FT_RESPONSE, f.rid, {**reply, "blobs": len(rblobs)})
@@ -1370,6 +1536,25 @@ class WorkerServer:
         staged = self.scheduler.apply_knobs(**dict(op.get("knobs") or {}))
         return {"staged": staged, "knobs": self.scheduler.knobs()}
 
+    def _op_metrics_pull(self, op, blobs):
+        """Fleet-observability pull: the worker's full MERGEABLE registry
+        state (``MetricsRegistry.export_state`` — counters, gauges,
+        histogram bucket/sample states) plus, when ``spans`` is set, the
+        chrome trace events recorded since the last pull (watermarked
+        drain — each batch ships once).  ``ts`` is this process's
+        ``perf_counter`` reading so the collector can sanity-check its
+        heartbeat-derived clock offset.  Served on the engine owner thread
+        here (the stdio/RPC path; socket collectors use the dedicated
+        metrics channel instead) — pure host state, no device sync."""
+        tel = self._telemetry
+        out: Dict[str, Any] = {
+            "metrics": tel.registry.export_state(),
+            "ts": time.perf_counter(),
+        }
+        if op.get("spans"):
+            out["events"] = tel.drain_chrome_events()
+        return out
+
     def _op_close(self, op, blobs):
         self.close_audit = self.engine.close()
         self._running = False
@@ -1393,7 +1578,8 @@ class WorkerServer:
 
 __all__ = [
     "ChaosLink", "ConnectionLost", "Frame", "FrameStream",
-    "HEARTBEAT_CHANNEL", "HeartbeatMonitor", "PROTO_VERSION",
+    "HEARTBEAT_CHANNEL", "HeartbeatMonitor", "METRICS_CHANNEL",
+    "MetricsChannel", "PROTO_VERSION",
     "ProtocolError", "RPC_CHANNEL", "RpcClient", "RpcTimeout",
     "TransportError", "WorkerDead", "WorkerServer", "client_handshake",
     "decode_handoff", "dial", "encode_handoff", "pack_frame",

@@ -9,6 +9,13 @@
   wait / accept rate), Chrome trace-event export (Perfetto-loadable),
   ``Telemetry`` facade with the optional ``jax.profiler`` step-annotation
   hook.
+- ``fleet.py`` — the fleet observability plane: ``FleetRegistry`` merges
+  per-worker registry snapshots (counter rollups + histogram merges with
+  the documented quantile bound), ``SloMonitor`` computes availability
+  and multi-window burn rates over the router's terminal counters,
+  ``FleetCollector`` pulls workers on a paced thread, and
+  ``fleet_chrome_trace`` stitches every process's spans onto one
+  clock-aligned Perfetto timeline.
 """
 from .registry import (  # noqa: F401
     Counter,
@@ -28,4 +35,11 @@ from .tracing import (  # noqa: F401
     Span,
     Telemetry,
     TraceRecorder,
+)
+from .fleet import (  # noqa: F401
+    FleetCollector,
+    FleetRegistry,
+    SloMonitor,
+    attach_fleet_collector,
+    fleet_chrome_trace,
 )

@@ -266,6 +266,117 @@ class Histogram:
             self._max = -math.inf
             self._window.clear()
 
+    # -- mergeable state (the fleet-observability wire format) --------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Serializable snapshot of the FULL histogram state — bucket
+        geometry, bucket counts, the raw-sample list while still exact
+        (None once degraded), and the recent-sample window.  JSON-safe
+        (no infinities: min/max are None on an empty histogram); the
+        payload the ``metrics_pull`` wire op ships and
+        :meth:`merge` / :meth:`from_state` consume."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "lo": self._lo,
+                "growth": self._growth,
+                "counts": list(self._counts),
+                "samples": (None if self._samples is None
+                            else list(self._samples)),
+                "count": self.count,
+                "sum": self.sum,
+                "min": None if self.count == 0 else self._min,
+                "max": None if self.count == 0 else self._max,
+                "exact_limit": self.exact_limit,
+                "window": list(self._window),
+                "window_limit": self._window.maxlen,
+            }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "Histogram":
+        """Rebuild a histogram from :meth:`state_dict` output (the
+        collector side of the pull).  Bucket geometry is restored exactly
+        from the state — never re-derived from ``hi`` — so a
+        round-tripped histogram merges cleanly with its source."""
+        h = cls(str(state.get("name", "restored")),
+                lo=float(state["lo"]), growth=float(state["growth"]),
+                exact_limit=int(state.get("exact_limit", 4096)),
+                window_limit=int(state.get("window_limit") or 512))
+        with h._lock:
+            h._counts = [int(c) for c in state["counts"]]
+            samples = state.get("samples")
+            h._samples = None if samples is None \
+                else [float(v) for v in samples]
+            h._sorted = None
+            h.count = int(state["count"])
+            h.sum = float(state["sum"])
+            h._min = math.inf if state.get("min") is None \
+                else float(state["min"])
+            h._max = -math.inf if state.get("max") is None \
+                else float(state["max"])
+            h._window.extend(float(v) for v in state.get("window") or ())
+        return h
+
+    def merge(self, other) -> "Histogram":
+        """Fold another histogram (or a :meth:`state_dict` payload) into
+        this one, in place.  The fleet rollup primitive.
+
+        Quantile error bound: while BOTH sides are exact and the combined
+        sample count fits ``exact_limit``, the merged histogram keeps the
+        pooled raw samples, and quantiles stay exact (identical to
+        observing every sample on one histogram).  Past that the merge
+        degrades to bucket counts — bucket-wise addition over an identical
+        geometry gives exactly the bucket counts the pooled sample stream
+        would have produced, and the geometric-midpoint estimate over a
+        log-``growth`` bucket is within ``sqrt(growth)`` relative error of
+        any sample inside it.  Merging therefore degrades NO WORSE than
+        the single-histogram bound: relative quantile error <=
+        ``sqrt(growth)`` (the PR 5 bound), plus nearest-rank's half-sample
+        rank slack — merging adds no error of its own.  The min/max clamp
+        stays exact (min/max combine losslessly).
+
+        Requires identical bucket geometry ``(lo, growth, n_buckets)`` —
+        merging mismatched bases would smear counts across bucket edges
+        unboundedly, so it raises ``ValueError`` instead.  The recent-
+        sample window is a best-effort union bounded by the ring size
+        (windowed views are per-process drift signals, not a merge
+        surface).  Commutative and associative in distribution: bucket
+        counts, count/sum/min/max, and exactness are order-independent.
+        Returns ``self``.
+        """
+        state = other.state_dict() if isinstance(other, Histogram) else other
+        with self._lock:
+            if (abs(float(state["lo"]) - self._lo) > 1e-12 * self._lo
+                    or abs(float(state["growth"]) - self._growth) > 1e-12
+                    or len(state["counts"]) != len(self._counts)):
+                raise ValueError(
+                    f"histogram merge requires identical bucket geometry: "
+                    f"{self.name} has (lo={self._lo}, growth={self._growth}, "
+                    f"buckets={len(self._counts)}), other has "
+                    f"(lo={state['lo']}, growth={state['growth']}, "
+                    f"buckets={len(state['counts'])})")
+            o_count = int(state["count"])
+            if o_count == 0:
+                return self
+            for i, c in enumerate(state["counts"]):
+                self._counts[i] += int(c)
+            self.count += o_count
+            self.sum += float(state["sum"])
+            if state.get("min") is not None:
+                self._min = min(self._min, float(state["min"]))
+            if state.get("max") is not None:
+                self._max = max(self._max, float(state["max"]))
+            o_samples = state.get("samples")
+            if (self._samples is not None and o_samples is not None
+                    and len(self._samples) + len(o_samples)
+                    <= self.exact_limit):
+                self._samples.extend(float(v) for v in o_samples)
+                self._sorted = None
+            else:
+                self._samples = None  # either side degraded, or over cap
+                self._sorted = None
+            self._window.extend(float(v) for v in state.get("window") or ())
+        return self
+
     def __repr__(self) -> str:
         return (f"Histogram({self.name}: n={self.count} mean={self.mean:.4g} "
                 f"p50={self.percentile(50):.4g})")
@@ -461,6 +572,40 @@ class MetricsRegistry:
             for q in (50, 90, 99):
                 events.append((f"{name}/p{q}", h.percentile(q), step))
         return events
+
+    def export_state(self, prefixes: Optional[Sequence[str]] = None
+                     ) -> Dict[str, Any]:
+        """Serializable MERGEABLE snapshot of the registry: counter values,
+        gauge values, and full histogram states (:meth:`Histogram.state_dict`
+        — bucket counts + raw samples while exact), optionally filtered to
+        metrics under ``prefixes`` (each matching ``p`` or ``p/...``).
+        This is the ``metrics_pull`` wire payload; unlike :meth:`snapshot`
+        (pre-computed quantile sub-labels, lossy), the receiving side can
+        MERGE these across workers and still compute fleet-true quantiles.
+        Counters export even when disabled (they always count); gauges and
+        histograms only exist when enabled.  The per-metric locks make each
+        metric's state internally consistent; the registry lock makes the
+        table listing atomic — a pull racing live observes sees a torn
+        *set* of fresh values, never a torn metric."""
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        if prefixes is not None:
+            pats = tuple(prefixes)
+
+            def _keep(name: str) -> bool:
+                return any(name == p or name.startswith(p + "/")
+                           for p in pats)
+
+            counters = [(n, c) for n, c in counters if _keep(n)]
+            gauges = [(n, g) for n, g in gauges if _keep(n)]
+            hists = [(n, h) for n, h in hists if _keep(n)]
+        return {
+            "counters": {n: c.value for n, c in sorted(counters)},
+            "gauges": {n: g.value for n, g in sorted(gauges)},
+            "histograms": {n: h.state_dict() for n, h in sorted(hists)},
+        }
 
     # -- namespaces ---------------------------------------------------------
     def _claim_locked(self, prefixes: Sequence[str]) -> List[str]:

@@ -129,6 +129,14 @@ class TraceRecorder:
         self._device_windows: "deque[Dict[str, Any]]" = deque(maxlen=4096)
         self._last_ready: Dict[str, float] = {}
         self.dropped = 0
+        # incremental-export watermarks (the fleet metrics_pull drains span
+        # events in batches without disturbing the full chrome export) plus
+        # a PERSISTENT track->tid map so tids stay stable across batches
+        self._appended_total = 0
+        self._drained_spans = 0
+        self._windows_total = 0
+        self._drained_windows = 0
+        self._drain_tids: Dict[str, int] = {}
 
     def start(self, name: str, track: str = "default", hist=None, **args):
         if not self.enabled:
@@ -140,6 +148,7 @@ class TraceRecorder:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1  # no silent cap: surfaced in chrome args
             self._spans.append(span)
+            self._appended_total += 1
             if pending:
                 self._pending.append(span)
                 return
@@ -201,6 +210,7 @@ class TraceRecorder:
                 if sp._hist is not None:
                     sp._hist.observe(per_ms)
             self._last_ready[track] = now
+            self._windows_total += 1
             self._device_windows.append({
                 "name": f"{group[0].name} window ({len(group)} dispatches)",
                 "track": f"{track}-device",
@@ -209,6 +219,19 @@ class TraceRecorder:
                 "args": {"dispatches": len(group),
                          "per_dispatch_ms": round(per_ms, 3)},
             })
+
+    @staticmethod
+    def _span_event(s: Span, pid: int, tid: int) -> Dict[str, Any]:
+        dur = s.duration_ms
+        args = dict(s.args)
+        if s.t_dispatch is not None:
+            args["dispatch_ms"] = round((s.t_dispatch - s.t0) * 1e3, 3)
+        if s.device_ms is not None:
+            args["device_window_avg_ms"] = round(s.device_ms, 3)
+        return {
+            "name": s.name, "ph": "X", "pid": pid, "tid": tid,
+            "ts": s.t0 * 1e6, "dur": (dur or 0.0) * 1e3, "args": args,
+        }
 
     def chrome_events(self, pid: int = 0) -> List[Dict[str, Any]]:
         with self._lock:
@@ -221,23 +244,46 @@ class TraceRecorder:
             events.append({"name": "thread_name", "ph": "M", "pid": pid,
                            "tid": tid, "args": {"name": t}})
         for s in spans:
-            dur = s.duration_ms
-            args = dict(s.args)
-            if s.t_dispatch is not None:
-                args["dispatch_ms"] = round((s.t_dispatch - s.t0) * 1e3, 3)
-            if s.device_ms is not None:
-                args["device_window_avg_ms"] = round(s.device_ms, 3)
-            events.append({
-                "name": s.name, "ph": "X", "pid": pid,
-                "tid": tid_of[s.track], "ts": s.t0 * 1e6,
-                "dur": (dur or 0.0) * 1e3, "args": args,
-            })
+            events.append(self._span_event(s, pid, tid_of[s.track]))
         for w in windows:
             events.append({
                 "name": w["name"], "ph": "X", "pid": pid,
                 "tid": tid_of[w["track"]], "ts": w["t0"] * 1e6,
                 "dur": w["dur"] * 1e6, "args": w["args"],
             })
+        return events
+
+    def drain_chrome_events(self, pid: int = 0) -> List[Dict[str, Any]]:
+        """Span/window events appended since the LAST drain — the
+        incremental batch a fleet ``metrics_pull`` returns.  Non-
+        destructive (the full :meth:`chrome_events` export is unchanged);
+        watermarks track how many events each consumer has seen, and the
+        track->tid map is persistent so tids stay stable across batches.
+        A still-deferred span exports its dispatch-side wall duration (the
+        device window resolves later as its own additive event).  No
+        device sync and no I/O happen here — pure state under the lock."""
+        with self._lock:
+            new_spans = self._appended_total - self._drained_spans
+            spans = list(self._spans)[-new_spans:] if new_spans else []
+            self._drained_spans = self._appended_total
+            new_w = self._windows_total - self._drained_windows
+            windows = list(self._device_windows)[-new_w:] if new_w else []
+            self._drained_windows = self._windows_total
+            events: List[Dict[str, Any]] = []
+            for t in {s.track for s in spans} | {w["track"] for w in windows}:
+                if t not in self._drain_tids:
+                    self._drain_tids[t] = len(self._drain_tids) + 1
+                    events.append({"name": "thread_name", "ph": "M",
+                                   "pid": pid, "tid": self._drain_tids[t],
+                                   "args": {"name": t}})
+            for s in spans:
+                events.append(self._span_event(s, pid, self._drain_tids[s.track]))
+            for w in windows:
+                events.append({
+                    "name": w["name"], "ph": "X", "pid": pid,
+                    "tid": self._drain_tids[w["track"]], "ts": w["t0"] * 1e6,
+                    "dur": w["dur"] * 1e6, "args": w["args"],
+                })
         return events
 
 
@@ -251,12 +297,14 @@ class RequestTrace:
     __slots__ = ("uid", "_tel", "_h", "prompt_tokens", "submit_ts",
                  "admit_ts", "first_token_ts", "last_emit_ts", "finish_ts",
                  "readmits", "preemptions", "tokens_emitted", "drafted",
-                 "accepted", "chunks", "emissions", "preempt_ts", "outcome")
+                 "accepted", "chunks", "emissions", "preempt_ts", "outcome",
+                 "ns")
 
     def __init__(self, tel: "Telemetry", uid: int, prompt_tokens: int = 0,
-                 hists: Optional[Dict[str, Any]] = None):
+                 hists: Optional[Dict[str, Any]] = None, ns: str = "serve"):
         self._tel = tel
         self._h = hists if hists is not None else tel.request_hists("serve")
+        self.ns = ns
         self.uid = uid
         self.prompt_tokens = prompt_tokens
         self.submit_ts: Optional[float] = None
@@ -523,6 +571,11 @@ class Telemetry:
         self.traces_dropped = 0
         self._lock = threading.Lock()
         self._req_hists: Dict[str, Dict[str, Any]] = {}
+        # fleet-pull watermark over finished traces (incremental drain),
+        # plus a persistent ns->pid map so drained batches keep stable pids
+        self._traces_total = 0
+        self._traces_drained = 0
+        self._drain_req_pids: Dict[str, int] = {"serve": 1}
         self._exit_registered = False
         # serve-request histograms (no-op singletons when disabled); the
         # default "serve" group is also exposed as h_* attributes — a second
@@ -605,7 +658,7 @@ class Telemetry:
         if not self.enabled:
             return NULL_REQUEST_TRACE
         return RequestTrace(self, uid, prompt_tokens,
-                            hists=self.request_hists(ns))
+                            hists=self.request_hists(ns), ns=ns)
 
     def _finish_request(self, trace: RequestTrace) -> None:
         if trace.e2e_ms is not None:
@@ -616,6 +669,7 @@ class Telemetry:
             if len(self._traces) == self._traces.maxlen:
                 self.traces_dropped += 1
             self._traces.append(trace)
+            self._traces_total += 1
         self.registry.event("request_finished", **trace.summary())
 
     @property
@@ -670,16 +724,39 @@ class Telemetry:
 
         atexit.register(_close_if_alive)
 
+    @staticmethod
+    def _request_pids(namespaces) -> Dict[str, int]:
+        """Per-namespace request pid blocks: the default ``serve``
+        namespace keeps pid 1 (single-process export is byte-compatible
+        with the pre-fleet layout: spans pid 0, requests pid 1), every
+        OTHER claimed namespace gets its own odd pid (3, 5, ...) in sorted
+        order — so merging two engines' (or two workers') traces never
+        aliases their request tracks onto one pid."""
+        rest = sorted(ns for ns in set(namespaces) if ns != "serve")
+        pids = {"serve": 1}
+        for i, ns in enumerate(rest):
+            pids[ns] = 3 + 2 * i
+        return pids
+
     def chrome_trace(self, path: Optional[str] = None) -> Dict[str, Any]:
         """Chrome trace-event JSON of everything recorded so far: engine
-        spans (pid 0, one tid per track) + request lifecycles (pid 1, tid =
-        uid).  Writes ``path`` when given; always returns the dict."""
+        spans (pid 0, one tid per track) + request lifecycles (one pid per
+        engine namespace — ``serve`` keeps pid 1, ``serve2``/... get their
+        own odd pids; tid = uid).  Writes ``path`` when given; always
+        returns the dict."""
         self.flush()
         events = self.recorder.chrome_events(pid=0)
         with self._lock:
             traces = list(self._traces)
+        pid_of = self._request_pids(tr.ns for tr in traces)
+        named = set()
         for tr in traces:
-            events.extend(tr.chrome_events(pid=1))
+            pid = pid_of[tr.ns]
+            if pid != 1 and pid not in named:
+                named.add(pid)
+                events.append({"name": "process_name", "ph": "M", "pid": pid,
+                               "tid": 0, "args": {"name": f"requests:{tr.ns}"}})
+            events.extend(tr.chrome_events(pid=pid))
         events = _strictly_order(events)
         out = {
             "traceEvents": events,
@@ -693,6 +770,25 @@ class Telemetry:
             with open(path, "w") as fh:
                 json.dump(out, fh)
         return out
+
+    def drain_chrome_events(self) -> List[Dict[str, Any]]:
+        """Chrome events recorded since the LAST drain: new recorder spans
+        plus the lifecycles of requests finished since then (pid layout as
+        :meth:`chrome_trace`).  The batch a fleet ``metrics_pull`` returns
+        — non-destructive (watermarked), no device sync, no file I/O, so
+        it is safe on the worker's RPC thread between ticks."""
+        events = self.recorder.drain_chrome_events(pid=0)
+        with self._lock:
+            new = self._traces_total - self._traces_drained
+            traces = list(self._traces)[-new:] if new else []
+            self._traces_drained = self._traces_total
+            pid_of = self._drain_req_pids
+            for ns in sorted({tr.ns for tr in traces}):
+                if ns not in pid_of:
+                    pid_of[ns] = 3 + 2 * (len(pid_of) - 1)
+        for tr in traces:
+            events.extend(tr.chrome_events(pid=pid_of[tr.ns]))
+        return events
 
     def close(self) -> None:
         self.flush()

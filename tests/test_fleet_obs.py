@@ -1,0 +1,381 @@
+"""Fleet observability plane (telemetry/fleet.py): FleetRegistry folds of
+per-worker snapshots (labeled views, counter rollups, merged-histogram
+summaries, deadline SLIs), SloMonitor availability + multi-window burn
+rates on a fake clock, FleetCollector pull loop (failure degradation,
+offsets, thread start/stop), the attach-style router seam
+(``attach_fleet_collector`` -> ``Router.signals()``/``close()``), the
+stitched ``fleet_chrome_trace`` pid blocks + clock-offset shift, the
+worker ``export_metrics`` facades, and the RouterConfig knob validation."""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config.config import ConfigError, RouterConfig
+from deepspeed_tpu.inference.sampling import SamplingParams
+from deepspeed_tpu.models import get_preset
+from deepspeed_tpu.models.transformer import init_params
+from deepspeed_tpu.serving import build_router
+from deepspeed_tpu.telemetry import (
+    FleetCollector,
+    FleetRegistry,
+    Histogram,
+    SloMonitor,
+    Telemetry,
+    attach_fleet_collector,
+    fleet_chrome_trace,
+)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _Counter:
+    def __init__(self, v=0):
+        self.value = v
+
+    def inc(self, n=1):
+        self.value += n
+
+
+def _payload(ns="serve", finished=0, ttft=(), events=(), ts=None,
+             exact_limit=4096, growth=2.0 ** 0.25):
+    h = Histogram(f"{ns}/ttft_ms", exact_limit=exact_limit, growth=growth)
+    for v in ttft:
+        h.observe(float(v))
+    return {
+        "metrics": {
+            "counters": {f"{ns}/finished": float(finished)},
+            "gauges": {f"{ns}/queue_depth": 2.0},
+            "histograms": {f"{ns}/ttft_ms": h.state_dict()},
+        },
+        "ts": ts,
+        "events": list(events),
+    }
+
+
+class _FakeWorker:
+    """export_metrics facade double: scripted payloads, None when dead."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.alive = True
+        self.pulls = 0
+
+    def export_metrics(self, spans=False):
+        self.pulls += 1
+        return self.payload if self.alive else None
+
+
+# ---------------------------------------------------------------------------
+# FleetRegistry: ingest, labeled views, rollups, merged quantiles
+# ---------------------------------------------------------------------------
+def test_fleet_registry_views_strip_namespaces_and_roll_up():
+    fleet = FleetRegistry()
+    # worker0 claimed "serve", worker1 (same process family) "serve2":
+    # the per-process suffix must not leak into fleet keys
+    fleet.ingest("worker0", _payload(ns="serve", finished=3, ttft=[10, 20]))
+    fleet.ingest("worker1", _payload(ns="serve2", finished=4, ttft=[30]))
+    views = fleet.labeled_views()
+    assert views["fleet/worker0/finished"] == 3.0
+    assert views["fleet/worker1/finished"] == 4.0
+    assert views["fleet/worker1/queue_depth"] == 2.0
+    assert fleet.counter_rollup() == {"finished": 7.0}
+    # snapshots REPLACE (cumulative totals, not deltas)
+    fleet.ingest("worker0", _payload(ns="serve", finished=5, ttft=[10, 20]))
+    assert fleet.counter_rollup() == {"finished": 9.0}
+    assert fleet.workers() == ["worker0", "worker1"]
+
+
+def test_fleet_registry_merged_summary_and_fraction_above():
+    fleet = FleetRegistry()
+    fleet.ingest("a", _payload(ttft=[1.0, 2.0, 3.0]))
+    fleet.ingest("b", _payload(ns="serve2", ttft=[4.0, 5.0]))
+    merged = fleet.merged_histogram("ttft_ms")
+    assert merged.count == 5 and merged.exact
+    assert merged.percentile(50) == 3.0  # pooled nearest-rank, exact
+    table = fleet.merged_summary(metrics=("ttft_ms", "absent_ms"))
+    assert set(table) == {"ttft_ms"}  # absent metrics are skipped
+    assert table["ttft_ms"]["count"] == 5.0
+    assert table["ttft_ms"]["p99"] == 5.0
+    assert fleet.fraction_above("ttft_ms", 3.5) == pytest.approx(2 / 5)
+    assert fleet.fraction_above("absent_ms", 1.0) is None
+    assert fleet.merged_histogram("absent_ms") is None
+
+
+def test_fleet_registry_mismatched_geometry_counts_conflict():
+    fleet = FleetRegistry()
+    fleet.ingest("a", _payload(ttft=[1.0, 2.0]))
+    fleet.ingest("b", _payload(ns="serve2", ttft=[8.0], growth=1.5))
+    merged = fleet.merged_histogram("ttft_ms")
+    # the mismatched shard is skipped, not smeared into the rollup
+    assert merged.count == 2
+    assert fleet.merge_conflicts == 1
+
+
+def test_fleet_registry_event_cap_drops_and_counts():
+    fleet = FleetRegistry(max_events_per_worker=3)
+    evs = [{"name": f"e{i}", "ph": "X", "pid": 0, "tid": 1,
+            "ts": float(i), "dur": 1.0} for i in range(5)]
+    fleet.ingest("w", _payload(events=evs[:2]))
+    fleet.ingest("w", _payload(events=evs[2:]))
+    assert len(fleet.events()["w"]) == 3
+    assert fleet.events_dropped == 2
+
+
+# ---------------------------------------------------------------------------
+# SloMonitor: availability, burn-rate windows, counter reset
+# ---------------------------------------------------------------------------
+def _slo(objective=0.9, fast=10.0, slow=100.0, **kw):
+    c = {"finished": _Counter(), "failed": _Counter(),
+         "timed_out": _Counter()}
+    return c, SloMonitor(c, objective=objective, fast_window_s=fast,
+                         slow_window_s=slow, **kw)
+
+
+def test_slo_monitor_availability_and_burn_rates_fake_clock():
+    c, slo = _slo()
+    assert slo.availability() == 1.0  # no terminals yet
+    assert slo.error_budget == pytest.approx(0.1)
+    slo.sample(0.0)
+    # 0..10 s: 9 good, 1 bad -> error fraction 0.1 == budget -> burn 1.0
+    c["finished"].inc(9)
+    c["failed"].inc(1)
+    slo.sample(10.0)
+    assert slo.availability() == pytest.approx(0.9)
+    assert slo.burn_rate(10.0, 10.0) == pytest.approx(1.0)
+    # 10..20 s: 10 good, 0 bad -> fast window clean, slow window smoulders
+    c["finished"].inc(10)
+    slo.sample(20.0)
+    assert slo.burn_rate(20.0, 10.0) == pytest.approx(0.0)
+    assert slo.burn_rate(20.0, 100.0) == pytest.approx(0.5)
+    rep = slo.report(20.0)
+    assert rep["availability"] == pytest.approx(19 / 20)
+    assert rep["fast_burn_rate"] == pytest.approx(0.0)
+    assert rep["slow_burn_rate"] == pytest.approx(0.5)
+    assert rep["finished"] == 19.0 and rep["errors"] == 1.0
+
+
+def test_slo_monitor_counter_reset_clears_window_not_availability():
+    c, slo = _slo()
+    c["finished"].inc(5)
+    slo.sample(0.0)
+    # a router rebuild resets counters; the ring must not go negative
+    c["finished"].value = 2
+    slo.sample(1.0)
+    assert slo.burn_rate(1.0, 10.0) == 0.0  # single post-reset sample
+    assert slo.availability() == 1.0
+
+
+def test_slo_monitor_deadline_slis_from_fleet():
+    fleet = FleetRegistry()
+    fleet.ingest("a", _payload(ttft=[10.0, 20.0, 200.0, 400.0]))
+    c, slo = _slo(ttft_deadline_ms=100.0)
+    rep = slo.report(0.0, fleet=fleet)
+    assert rep["ttft_deadline_viol_frac"] == pytest.approx(0.5)
+    assert "e2e_deadline_viol_frac" not in rep  # e2e deadline unset
+    with pytest.raises(ValueError):
+        SloMonitor({"finished": _Counter(), "failed": _Counter(),
+                    "timed_out": _Counter()}, objective=1.0)
+
+
+# ---------------------------------------------------------------------------
+# FleetCollector: pulls, failure degradation, offsets, thread lifecycle
+# ---------------------------------------------------------------------------
+def test_collector_pull_once_folds_failures_and_offsets():
+    fleet = FleetRegistry()
+    good = _FakeWorker(_payload(finished=2, ttft=[5.0]))
+    dead = _FakeWorker(None)
+    dead.alive = False
+    dead.payload = None
+    clk = _Clock()
+    c, slo = _slo()
+    coll = FleetCollector(
+        fleet, lambda: [("w0", good), ("w1", dead)], interval_s=0.01,
+        offsets_fn=lambda name: (1.5, 0.1) if name == "w0" else None,
+        slo=slo, clock=clk)
+    assert coll.pull_once() == 1
+    snap = fleet.snapshot()
+    assert snap["w0"]["pulls"] == 1 and snap["w0"]["failures"] == 0
+    assert snap["w1"]["pulls"] == 0 and snap["w1"]["failures"] == 1
+    assert fleet.offset("w0") == (1.5, 0.1)
+    # the pull sampled the SLO ring on the injected clock
+    clk.t = 5.0
+    assert coll.pull_once() == 1
+    assert len(slo._ring) == 2 and slo._ring[-1][0] == 5.0
+
+
+def test_collector_thread_start_stop_final_pull():
+    fleet = FleetRegistry()
+    w = _FakeWorker(_payload(finished=1))
+    coll = FleetCollector(fleet, lambda: [("w0", w)], interval_s=0.005)
+    coll.start()
+    assert coll.start() is coll  # idempotent
+    deadline = threading.Event()
+    for _ in range(200):
+        if fleet.snapshot().get("w0", {}).get("pulls", 0) >= 2:
+            break
+        deadline.wait(0.01)
+    pulls_before = w.pulls
+    coll.stop(final_pull=True)
+    assert w.pulls >= pulls_before + 1  # the terminal synchronous pass
+    assert pulls_before >= 2, "collector thread never pulled"
+    pulls_after = w.pulls
+    deadline.wait(0.03)
+    assert w.pulls == pulls_after  # loop actually stopped
+    coll.stop()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# stitched chrome trace: pid blocks + clock-offset shift
+# ---------------------------------------------------------------------------
+def test_fleet_chrome_trace_pid_blocks_and_offset_shift(tmp_path):
+    fleet = FleetRegistry()
+    ev = {"name": "tick", "ph": "X", "pid": 0, "tid": 1,
+          "ts": 1000.0, "dur": 5.0}
+    req = {"name": "queued", "ph": "X", "pid": 1, "tid": 7,
+           "ts": 2000.0, "dur": 5.0}
+    fleet.ingest("w0", _payload(events=[ev, req]))
+    fleet.ingest("w1", _payload(ns="serve2", events=[dict(ev, ts=3000.0)]))
+    # w1's clock runs 1 ms ahead of the router's
+    fleet.note_offset("w1", (1e-3, 1e-4))
+    tel = Telemetry(True)
+    tel.recorder.start("route", track="router", uid=7).end()
+    out = fleet_chrome_trace(fleet, telemetry=tel,
+                             path=str(tmp_path / "fleet.json"))
+    evs = out["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # router spans stay in block 0; workers own blocks 100 and 200
+    assert any(e["pid"] == 0 and e["name"] == "route" for e in xs)
+    assert {e["pid"] for e in xs if e["name"] == "tick"} == {100, 200}
+    assert any(e["pid"] == 101 and e["name"] == "queued" for e in xs)
+    # w1's span shifted onto the router timeline: 3000 - 1000 us offset
+    w1_tick = next(e for e in xs if e["pid"] == 200)
+    assert w1_tick["ts"] == pytest.approx(2000.0)
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names[100] == "w0" and names[101] == "w0:requests+1"
+    assert names[0] == "router"
+    assert out["metadata"]["workers"]["w1"]["clock_offset_s"] == 1e-3
+    assert (tmp_path / "fleet.json").stat().st_size > 0
+    # ts strictly ordered per (pid, tid) — Perfetto-loadable
+    by_track = {}
+    for e in xs:
+        by_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    for key, ts in by_track.items():
+        assert all(b > a for a, b in zip(ts, ts[1:])), key
+
+
+# ---------------------------------------------------------------------------
+# router integration: attach seam, signals shape, export facades
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def routed_fleet():
+    cfg = get_preset("tiny", max_seq_len=128, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg=cfg, dtype=jnp.float32)
+    sec = dict(max_seqs=4, num_blocks=48, block_size=8,
+               prefill_buckets=[16, 32], max_seq_len=128)
+    tel = Telemetry(True)
+    router = build_router(params, cfg, sec,
+                         router=dict(n_workers=2,
+                                     metrics_pull_interval_ms=20.0),
+                         telemetry=tel)
+    collector = attach_fleet_collector(router, start=False)
+    rng = np.random.default_rng(0)
+    samp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    prompts = {u: rng.integers(1, cfg.vocab_size, 12).tolist()
+               for u in range(1, 7)}
+    for u, p in prompts.items():
+        assert router.try_submit(u, p, samp).accepted
+    out = router.run()
+    collector.pull_once()
+    yield router, collector, out, prompts
+    router.close()
+
+
+def test_attach_reads_config_knobs_and_worker_facades(routed_fleet):
+    router, collector, out, prompts = routed_fleet
+    assert collector._interval == pytest.approx(0.02)  # from RouterConfig
+    assert router._fleet_collector is collector
+    fleet = collector.fleet
+    assert fleet.workers() == ["worker0", "worker1"]
+    # the in-process facade payload: per-worker namespaced slices only
+    w0, w1 = router.pool.workers
+    p0 = w0.export_metrics()
+    prefixes = tuple(p for p in (w0.engine._ns, w0.engine._sched_ns,
+                                 getattr(w0.engine, "_comm_ns", None)) if p)
+    assert all(k.startswith(prefixes)
+               for k in p0["metrics"]["counters"])
+    # every submitted request is visible in the fleet rollup
+    roll = fleet.counter_rollup()
+    assert roll["sched/finished"] == float(len(prompts))
+    assert fleet.merged_histogram("ttft_ms").count == len(prompts)
+    # labeled per-worker views exist for both workers
+    views = fleet.labeled_views()
+    assert any(k.startswith("fleet/worker0/") for k in views)
+    assert any(k.startswith("fleet/worker1/") for k in views)
+    # a dead worker's facade degrades to None
+    victim = router.pool.workers[1]
+    try:
+        victim.alive = False
+        assert victim.export_metrics() is None
+    finally:
+        victim.alive = True
+
+
+def test_router_signals_shape_mirrors_scheduler(routed_fleet):
+    router, collector, out, prompts = routed_fleet
+    sig = router.signals()
+    for key in ("tick_no", "workers_alive", "backlog", "inflight",
+                "queue_depth", "shed_pressure", "shedding",
+                "headroom_fraction", "worker_backoff_s", "rates",
+                "counters", "fleet", "fleet_counters", "slo"):
+        assert key in sig, key
+    assert sig["workers_alive"] == 2
+    assert sig["backlog"] == 0 and sig["inflight"] == 0
+    assert set(sig["rates"]) == {"discovered_deaths", "replays",
+                                 "shed_rejections", "no_worker_refusals"}
+    assert sig["counters"]["finished"] == len(prompts)
+    assert sig["slo"]["availability"] == 1.0
+    assert sig["slo"]["objective"] == 0.999  # RouterConfig default
+    assert sig["fleet"]["worker0"]["pulls"] >= 1
+    assert sig["fleet_counters"]["sched/finished"] == float(len(prompts))
+    assert 0.0 <= sig["headroom_fraction"] <= 1.0
+
+
+def test_router_close_stops_collector(routed_fleet):
+    # exercised via a throwaway router so the fixture stays usable
+    cfg = get_preset("tiny", max_seq_len=64, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(1), cfg=cfg, dtype=jnp.float32)
+    sec = dict(max_seqs=2, num_blocks=16, block_size=8,
+               prefill_buckets=[16], max_seq_len=64)
+    r = build_router(params, cfg, sec, router=dict(n_workers=2))
+    coll = attach_fleet_collector(r, interval_s=0.005, start=True)
+    audits = r.close()
+    assert all(a["blocks_in_use"] == 0 for a in audits)
+    assert coll._thread is None  # stopped (and final-pulled) by close()
+    assert r._fleet_collector is None
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+def test_router_config_fleet_knob_validation():
+    RouterConfig(metrics_pull_interval_ms=100.0)  # valid
+    with pytest.raises(ConfigError):
+        RouterConfig(metrics_pull_interval_ms=0.0)
+    with pytest.raises(ConfigError):
+        RouterConfig(slo_objective=1.0)
+    with pytest.raises(ConfigError):
+        RouterConfig(slo_objective=0.0)
+    with pytest.raises(ConfigError):
+        RouterConfig(slo_fast_window_s=0.0)
+    with pytest.raises(ConfigError):
+        RouterConfig(slo_fast_window_s=60.0, slo_slow_window_s=5.0)

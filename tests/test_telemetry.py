@@ -429,6 +429,203 @@ def test_train_engine_telemetry_spans_and_monitor_fanout():
 
 
 # ---------------------------------------------------------------------------
+# histogram merge laws: the fleet-observability wire primitive
+# ---------------------------------------------------------------------------
+def test_histogram_merge_matches_pooled_ground_truth():
+    """Sharding a sample stream across N histograms and merging the states
+    must reproduce the single pooled histogram bucket-for-bucket, and the
+    merged quantiles stay within the documented sqrt(growth) bound of the
+    true (raw-sample) percentiles — merging adds no error of its own."""
+    rng = np.random.default_rng(7)
+    vals = np.exp(rng.normal(3.0, 1.0, 3000))  # decades of spread
+    growth = 2 ** 0.25
+    shards = [Histogram(f"s{i}", exact_limit=16, growth=growth)
+              for i in range(4)]
+    pooled = Histogram("pooled", exact_limit=16, growth=growth)
+    for i, v in enumerate(vals):
+        shards[i % 4].observe(float(v))
+        pooled.observe(float(v))
+    merged = Histogram.from_state(shards[0].state_dict())
+    for s in shards[1:]:
+        merged.merge(s.state_dict())
+    assert merged.count == pooled.count == len(vals)
+    assert merged._counts == pooled._counts  # bucket-wise identical
+    assert merged.min == pooled.min and merged.max == pooled.max
+    assert merged.sum == pytest.approx(pooled.sum)
+    bound = growth ** 0.5 + 0.02
+    for q in (50, 90, 99):
+        est, true = merged.percentile(q), float(np.percentile(vals, q))
+        assert 1 / bound <= est / true <= bound, (q, est, true)
+        assert merged.percentile(q) == pooled.percentile(q)
+
+
+def test_histogram_merge_commutative_and_associative():
+    rng = np.random.default_rng(3)
+    shards = []
+    for i in range(3):
+        h = Histogram(f"s{i}", exact_limit=8)
+        for v in rng.uniform(0.5, 500.0, 40):
+            h.observe(float(v))
+        shards.append(h)
+    a, b, c = (s.state_dict() for s in shards)
+
+    def fold(*states):
+        m = Histogram.from_state(states[0])
+        for st in states[1:]:
+            m.merge(st)
+        return m
+
+    abc = fold(a, b, c)
+    cba = fold(c, b, a)
+    ab_c = fold(fold(a, b).state_dict(), c)
+    a_bc = fold(a, fold(b, c).state_dict())
+    for other in (cba, ab_c, a_bc):
+        assert other._counts == abc._counts
+        assert other.count == abc.count
+        assert other.sum == pytest.approx(abc.sum)
+        assert other.min == abc.min and other.max == abc.max
+        for q in (50, 90, 99):
+            assert other.percentile(q) == abc.percentile(q)
+
+
+def test_histogram_merge_exact_until_cap_then_degrades():
+    a = Histogram("a", exact_limit=10)
+    b = Histogram("b", exact_limit=10)
+    for v in (1.0, 2.0, 3.0):
+        a.observe(v)
+    for v in (4.0, 5.0):
+        b.observe(v)
+    m = Histogram.from_state(a.state_dict()).merge(b)
+    assert m.exact and m.count == 5
+    # exact+exact under the cap: quantiles == pooled nearest-rank, exactly
+    assert m.percentile(50) == 3.0 and m.percentile(100) == 5.0
+    # an empty merge is a no-op and cannot degrade exactness
+    m.merge(Histogram("empty", exact_limit=10))
+    assert m.exact and m.count == 5
+    # pushing past the cap drops the raw samples; totals are preserved
+    c = Histogram("c", exact_limit=10)
+    for v in range(1, 9):
+        c.observe(float(v))
+    m.merge(c)
+    assert not m.exact and m.count == 13
+    assert m.min == 1.0 and m.max == 8.0
+    # degradation is one-way: an exact shard cannot resurrect samples
+    d = Histogram("d", exact_limit=10)
+    d.observe(2.5)
+    m.merge(d)
+    assert not m.exact and m.count == 14
+
+
+def test_histogram_merge_mismatched_geometry_raises():
+    base = Histogram("base")
+    base.observe(1.0)
+    for bad in (Histogram("g", growth=1.5), Histogram("lo", lo=1e-2),
+                Histogram("hi", hi=1e9)):  # hi changes the bucket COUNT
+        bad.observe(2.0)
+        with pytest.raises(ValueError):
+            Histogram.from_state(base.state_dict()).merge(bad.state_dict())
+    # the failed merge left the receiver untouched
+    m = Histogram.from_state(base.state_dict())
+    with pytest.raises(ValueError):
+        m.merge(Histogram("g2", growth=1.5).state_dict())
+    assert m.count == 1 and m.percentile(50) == 1.0
+
+
+def test_histogram_state_dict_json_round_trip():
+    h = Histogram("h", exact_limit=4)
+    for v in (1.0, 10.0, 100.0, 1000.0, 10000.0):  # degraded (over cap)
+        h.observe(v)
+    state = json.loads(json.dumps(h.state_dict()))  # wire-safe
+    back = Histogram.from_state(state)
+    assert back._counts == h._counts and back.count == h.count
+    assert back.min == h.min and back.max == h.max
+    assert not back.exact
+    back.merge(h.state_dict())  # geometry survived the round trip
+    assert back.count == 2 * h.count
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace pid namespaces: multi-engine exports must not alias
+# ---------------------------------------------------------------------------
+def _finish_req(tel, uid, ns):
+    tr = tel.request_trace(uid, ns=ns)
+    tr.submitted(prompt_tokens=2)
+    tr.admitted()
+    tr.tokens(1)
+    tr.finished()
+
+
+def test_chrome_trace_request_namespaces_get_distinct_pids():
+    """Regression: two engines sharing one Telemetry used to export BOTH
+    request tracks on pid 1 (uid collisions aliased the timelines).  Now
+    ``serve`` keeps pid 1 (byte-compat single-process layout) and every
+    other namespace gets its own odd pid plus a process_name row."""
+    tel = Telemetry(True)
+    for uid, ns in ((1, "serve"), (2, "serve2"), (3, "serve3")):
+        _finish_req(tel, uid, ns)
+    evs = tel.chrome_trace()["traceEvents"]
+    req_pids = {e["pid"] for e in evs if e["ph"] == "X" and e["pid"] >= 1}
+    assert req_pids == {1, 3, 5}
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names[3] == "requests:serve2" and names[5] == "requests:serve3"
+    # same uid in two namespaces: distinct (pid, tid) rows, no aliasing
+    tel2 = Telemetry(True)
+    _finish_req(tel2, 42, "serve")
+    _finish_req(tel2, 42, "serve2")
+    rows = {(e["pid"], e["tid"]) for e in tel2.chrome_trace()["traceEvents"]
+            if e["ph"] == "X" and e["pid"] >= 1}
+    assert len(rows) == 2
+
+
+def test_drain_chrome_events_namespace_pids_stable_across_drains():
+    tel = Telemetry(True)
+    _finish_req(tel, 1, "serve2")
+    first = tel.drain_chrome_events()
+    pids1 = {e["pid"] for e in first if e["ph"] == "X" and e["pid"] >= 1}
+    assert pids1 == {3}  # first non-serve namespace
+    _finish_req(tel, 2, "serve2")
+    _finish_req(tel, 3, "serve3")
+    second = tel.drain_chrome_events()
+    by_ns = {}
+    for e in second:
+        if e["ph"] == "X" and e["pid"] >= 1:
+            by_ns.setdefault(e["pid"], 0)
+    # serve2 kept pid 3 across drains; serve3 got the next odd pid
+    assert set(by_ns) == {3, 5}
+    # a drain is incremental: uid 1's lifecycle (tid = uid) from the first
+    # batch is not re-exported
+    assert not any(e["tid"] == 1 for e in second if e["ph"] == "X")
+
+
+# ---------------------------------------------------------------------------
+# heartbeat clock-offset estimation (fake timestamps)
+# ---------------------------------------------------------------------------
+def test_heartbeat_note_clock_offset_midpoint_and_min_rtt():
+    from deepspeed_tpu.serving.transport import HeartbeatMonitor
+
+    clk = _Clock()
+    mon = HeartbeatMonitor(clock=clk)
+    mon.watch(0, stream=None)
+    assert mon.clock_offset(0) is None  # nothing folded yet
+    # remote clock runs 100 s ahead; symmetric 2 s RTT -> exact midpoint
+    mon.note_clock(0, t_send=10.0, t_recv=12.0, remote_ts=111.0)
+    off, err = mon.clock_offset(0)
+    assert off == pytest.approx(100.0) and err == pytest.approx(1.0)
+    # a WORSE (higher-RTT) sample must not replace the estimate
+    mon.note_clock(0, t_send=20.0, t_recv=30.0, remote_ts=128.0)
+    off, err = mon.clock_offset(0)
+    assert off == pytest.approx(100.0) and err == pytest.approx(1.0)
+    # a tighter RTT wins and shrinks the error bound to RTT/2
+    mon.note_clock(0, t_send=40.0, t_recv=40.5, remote_ts=140.35)
+    off, err = mon.clock_offset(0)
+    assert off == pytest.approx(100.1) and err == pytest.approx(0.25)
+    # unknown endpoint: fold is a no-op, query returns None
+    mon.note_clock(9, t_send=0.0, t_recv=1.0, remote_ts=5.0)
+    assert mon.clock_offset(9) is None
+
+
+# ---------------------------------------------------------------------------
 # satellites: timer reset, monitor writers, marker hygiene
 # ---------------------------------------------------------------------------
 def test_timer_reset_clears_last():
